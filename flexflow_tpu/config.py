@@ -1,0 +1,198 @@
+"""FFConfig: runtime configuration + CLI flag parsing.
+
+Parity with the reference's hand-rolled argv scan
+(include/flexflow/config.h:92-160, src/runtime/model.cc:3500-3720): the same
+flags are accepted (`-b`, `--epochs`, `-e`, `--budget`, `--alpha`,
+`--only-data-parallel`, `--enable-parameter-parallel`, ...), plus TPU-native
+knobs (mesh axis sizes, bf16 policy). Legion `-ll:gpu/-ll:cpu` flags map to
+workers-per-node over the JAX device fleet.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+
+from .fftype import CompMode
+from .machine import DEFAULT_AXES, MeshShape
+
+
+@dataclass
+class FFConfig:
+    # training loop
+    epochs: int = 1
+    batch_size: int = 64
+    print_freq: int = 10
+    learning_rate: float = 0.01
+    weight_decay: float = 0.0001
+    # fleet description
+    num_nodes: int = 1
+    cpus_per_node: int = 4
+    workers_per_node: int = 0  # 0 → all local devices
+    device_mem: float = 0.0  # bytes of HBM per chip (0 → query)
+    # search
+    search_budget: int = 0
+    search_alpha: float = 1.2
+    search_overlap_backward_update: bool = False
+    simulator_work_space_size: int = 2 * 1024 * 1024 * 1024
+    search_num_nodes: Optional[int] = None
+    search_num_workers: Optional[int] = None
+    base_optimize_threshold: int = 10
+    enable_propagation: bool = False
+    perform_memory_search: bool = False
+    # parallelism gates (reference config.h:133-137)
+    only_data_parallel: bool = False
+    enable_sample_parallel: bool = False
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+    enable_inplace_optimizations: bool = False
+    enable_control_replication: bool = True
+    # execution
+    computation_mode: CompMode = CompMode.COMP_MODE_TRAINING
+    profiling: bool = False
+    perform_fusion: bool = False
+    synthetic_input: bool = False
+    allow_tensor_op_math_conversion: bool = True  # → bf16 matmuls on MXU
+    # files / misc
+    dataset_path: str = ""
+    import_strategy_file: str = ""
+    export_strategy_file: str = ""
+    export_strategy_task_graph_file: str = ""
+    export_strategy_computation_graph_file: str = ""
+    substitution_json_path: Optional[str] = None
+    machine_model_version: int = 0
+    machine_model_file: str = ""
+    simulator_segment_size: int = 16777216
+    simulator_max_num_segments: int = 1
+    python_data_loader_type: int = 2
+    # TPU-native additions
+    mesh_axis_sizes: Optional[tuple[int, ...]] = None  # (data, model, pipe, seq)
+    mesh_axis_names: tuple[str, ...] = DEFAULT_AXES
+    seed: int = 0
+
+    def __post_init__(self):
+        argv = sys.argv[1:]
+        self.parse_args(argv)
+        if self.workers_per_node == 0:
+            try:
+                self.workers_per_node = max(
+                    1, jax.local_device_count() // max(1, self.num_nodes)
+                )
+            except Exception:
+                self.workers_per_node = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.num_nodes * self.workers_per_node
+
+    def mesh_shape(self) -> MeshShape:
+        if self.mesh_axis_sizes is not None:
+            return MeshShape(tuple(self.mesh_axis_sizes), self.mesh_axis_names)
+        sizes = [self.num_devices] + [1] * (len(self.mesh_axis_names) - 1)
+        return MeshShape(tuple(sizes), self.mesh_axis_names)
+
+    # flag table mirrors model.cc:3556-3720
+    def parse_args(self, argv: list[str]):
+        i = 0
+        while i < len(argv):
+            a = argv[i]
+
+            def val():
+                nonlocal i
+                i += 1
+                return argv[i]
+
+            if a in ("-e", "--epochs"):
+                self.epochs = int(val())
+            elif a in ("-b", "--batch-size"):
+                self.batch_size = int(val())
+            elif a == "--lr":
+                self.learning_rate = float(val())
+            elif a == "--wd":
+                self.weight_decay = float(val())
+            elif a == "--printFreq":
+                self.print_freq = int(val())
+            elif a == "--budget" or a == "--search-budget":
+                self.search_budget = int(val())
+            elif a == "--alpha" or a == "--search-alpha":
+                self.search_alpha = float(val())
+            elif a == "--simulator-workspace-size":
+                self.simulator_work_space_size = int(val())
+            elif a == "--only-data-parallel":
+                self.only_data_parallel = True
+            elif a == "--enable-parameter-parallel":
+                self.enable_parameter_parallel = True
+            elif a == "--enable-attribute-parallel":
+                self.enable_attribute_parallel = True
+            elif a == "--enable-sample-parallel":
+                self.enable_sample_parallel = True
+            elif a == "--enable-inplace-optimizations":
+                self.enable_inplace_optimizations = True
+            elif a == "--search-overlap-backward-update":
+                self.search_overlap_backward_update = True
+            elif a == "--fusion":
+                self.perform_fusion = True
+            elif a == "--profiling":
+                self.profiling = True
+            elif a == "--dataset":
+                self.dataset_path = val()
+            elif a == "--import-strategy" or a == "--import":
+                self.import_strategy_file = val()
+            elif a == "--export-strategy" or a == "--export":
+                self.export_strategy_file = val()
+            elif a == "--taskgraph":
+                self.export_strategy_task_graph_file = val()
+            elif a == "--compgraph":
+                self.export_strategy_computation_graph_file = val()
+            elif a == "--machine-model-version":
+                self.machine_model_version = int(val())
+            elif a == "--machine-model-file":
+                self.machine_model_file = val()
+            elif a == "--segment-size":
+                self.simulator_segment_size = int(val())
+            elif a == "--max-num-segments":
+                self.simulator_max_num_segments = int(val())
+            elif a == "--enable-propagation":
+                self.enable_propagation = True
+            elif a == "--memory-search":
+                self.perform_memory_search = True
+            elif a == "--search-num-nodes":
+                self.search_num_nodes = int(val())
+            elif a == "--search-num-workers":
+                self.search_num_workers = int(val())
+            elif a == "--base-optimize-threshold":
+                self.base_optimize_threshold = int(val())
+            elif a == "--substitution-json":
+                self.substitution_json_path = val()
+            elif a == "--nodes":
+                self.num_nodes = int(val())
+            elif a == "-ll:gpu" or a == "-ll:tpu" or a == "--workers-per-node":
+                self.workers_per_node = int(val())
+            elif a == "-ll:cpu":
+                self.cpus_per_node = int(val())
+            elif a == "-ll:fsize":
+                self.device_mem = float(val()) * 1024 * 1024
+            elif a == "--mesh":
+                # TPU-native: --mesh data,model,pipe,seq e.g. "8,4,1,1"
+                self.mesh_axis_sizes = tuple(int(x) for x in val().split(","))
+            elif a == "--seed":
+                self.seed = int(val())
+            elif a == "--synthetic-input":
+                self.synthetic_input = True
+            # unknown flags are ignored, matching the reference's tolerant scan
+            i += 1
+
+
+class FFIterationConfig:
+    """Per-iteration config (reference config.h:162-167): seq_length enables
+    truncated-sequence batches."""
+
+    def __init__(self):
+        self.seq_length = -1
+
+    def reset(self):
+        self.seq_length = -1

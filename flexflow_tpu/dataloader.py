@@ -1,0 +1,53 @@
+"""Data loading.
+
+Reference: SingleDataLoader (python/flexflow_dataloader.h:34-100 +
+flexflow_dataloader.cc) — a two-stage path: the full numpy array is staged
+into zero-copy host memory once, then a per-batch GPU index task copies each
+shard's slice into framebuffer. TPU-native equivalent: the full array stays in
+host RAM (numpy); each `next_batch` slices on host and `device_put`s with the
+input's NamedSharding, so each chip receives exactly its shard over PCIe —
+same data-movement shape, no task runtime. Batches are issued round-robin
+with an epoch-stable order, matching reference semantics (sequential batches,
+reset() to restart).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding
+
+
+class SingleDataLoader:
+    def __init__(self, ffmodel, batch_tensor, full_array: np.ndarray):
+        self.ffmodel = ffmodel
+        self.batch_tensor = batch_tensor
+        self.full_array = np.ascontiguousarray(full_array)
+        self.num_samples = int(full_array.shape[0])
+        self.batch_size = batch_tensor.dims[0]
+        self.next_index = 0
+
+    @property
+    def num_batches(self) -> int:
+        return self.num_samples // self.batch_size
+
+    def reset(self):
+        self.next_index = 0
+
+    def next_batch(self, ffmodel=None) -> np.ndarray:
+        if self.next_index + self.batch_size > self.num_samples:
+            self.next_index = 0
+        sl = slice(self.next_index, self.next_index + self.batch_size)
+        self.next_index += self.batch_size
+        return self.full_array[sl]
+
+    def next_batch_sharded(self):
+        """Batch pre-placed on the mesh with the input's sharding."""
+        batch = self.next_batch()
+        ff = self.ffmodel
+        for node in ff.graph.sources():
+            if node.name == self.batch_tensor.name:
+                spec = node.outputs[0].partition_spec()
+                return jax.device_put(batch, NamedSharding(ff.mesh, spec))
+        return jax.device_put(batch)

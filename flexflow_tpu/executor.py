@@ -1,0 +1,243 @@
+"""Executor: lowers a PCG to one jitted SPMD training/eval step.
+
+This replaces the reference's entire L0-L2 stack (Legion index tasks + FFMapper
++ per-op CUDA kernels, SURVEY §1): the topo-ordered PCG becomes a single pure
+function traced under `jax.jit`; each node's searched placement is pinned with
+`with_sharding_constraint` (the GSPMD analog of tagging region requirements
+with `machine_view.hash()`, src/ops/linear.cc:352-359), so the plan the search
+chose is the plan XLA runs, and re-sharding between differently-placed ops is
+compiled into ICI collectives exactly where the reference would launch
+parallel-op copy tasks.
+
+Autodiff (`jax.value_and_grad`) replaces all hand-written backward tasks;
+Legion tracing (`begin_trace/end_trace` around each iteration) is subsumed by
+the jit compilation cache; the optimizer update runs sharded in the same
+program, so the whole training iteration is one XLA executable — the same
+"single traced hot loop" property the reference gets from Legion trace replay.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .config import FFConfig
+from .fftype import CompMode, LossType, OperatorType as OT, dtype_to_jnp
+from .initializer import initializer_by_name
+from .loss import loss_value
+from .metrics import Metrics
+from .ops.base import OpContext
+from .optimizer import Optimizer
+from .pcg.graph import Graph, OpNode
+
+
+def _stable_fold(key, name: str):
+    h = int.from_bytes(hashlib.md5(name.encode()).digest()[:4], "little")
+    return jax.random.fold_in(key, h)
+
+
+class Executor:
+    def __init__(
+        self,
+        graph: Graph,
+        mesh: Mesh,
+        config: FFConfig,
+        loss_type: LossType,
+        metrics: Metrics,
+        optimizer: Optimizer,
+        logits_node: OpNode,
+        label_spec: PartitionSpec,
+    ):
+        self.graph = graph
+        self.mesh = mesh
+        self.config = config
+        self.loss_type = loss_type
+        self.metrics = metrics
+        self.optimizer = optimizer
+        self.order = graph.topo_order()
+        self.logits_node = logits_node
+        self.label_spec = label_spec
+        self.last_op_is_softmax = logits_node.op_type == OT.OP_SOFTMAX
+        self._train_step = None
+        self._eval_step = None
+        self._forward_fn = None
+
+    # ------------------------------------------------------------ variables
+
+    def init_variables(self, rng):
+        """Initialize params (trainable) and state (non-trainable weights),
+        each placed with its searched sharding (replaces weight-region mapping
+        in model.cc map_weight + initializer tasks)."""
+        params, state = {}, {}
+        for node in self.order:
+            p, s = {}, {}
+            for i, ws in enumerate(node.weight_specs):
+                init = node.initializers.get(
+                    ws.name, initializer_by_name(ws.initializer)
+                )
+                key = _stable_fold(rng, f"{node.name}/{ws.name}")
+                arr = init(key, ws.shape, dtype_to_jnp(ws.dtype))
+                spec = node.weight_axes.get(ws.name, PartitionSpec())
+                arr = jax.device_put(arr, NamedSharding(self.mesh, spec))
+                (p if ws.trainable else s)[ws.name] = arr
+            if p:
+                params[node.name] = p
+            if s:
+                state[node.name] = s
+        return params, state
+
+    # ------------------------------------------------------------ apply
+
+    def _apply(self, params, state, inputs, *, training, rng, seq_length=-1):
+        """Run the PCG forward. Returns (logits, new_state, aux_loss)."""
+        vals: dict[tuple[int, int], Any] = {}
+        new_state = {k: dict(v) for k, v in state.items()}
+        aux_loss = 0.0
+        for node in self.order:
+            if node.op_type in (OT.OP_INPUT, OT.OP_WEIGHT, OT.OP_NOOP):
+                if node.op_type == OT.OP_INPUT:
+                    x = inputs[node.name]
+                    spec = node.outputs[0].partition_spec()
+                    if _spec_nontrivial(spec):
+                        x = jax.lax.with_sharding_constraint(
+                            x, NamedSharding(self.mesh, spec)
+                        )
+                    vals[(node.guid, 0)] = x
+                elif self.graph.in_edges[node.guid]:
+                    src, sidx = self.graph.producer(node, 0)
+                    vals[(node.guid, 0)] = vals[(src.guid, sidx)]
+                continue
+
+            ins = [None] * len(self.graph.in_edges[node.guid])
+            for e in self.graph.in_edges[node.guid]:
+                ins[e.dst_idx] = vals[(e.src, e.src_idx)]
+
+            weights = {}
+            weights.update(params.get(node.name, {}))
+            weights.update(new_state.get(node.name, {}))
+            ctx = OpContext(
+                training=training,
+                rng=_stable_fold(rng, node.name) if rng is not None else None,
+                seq_length=seq_length,
+                profiling=self.config.profiling,
+            )
+            op_state = new_state.get(node.name)
+            outs, op_state = node.op_def.forward(
+                node.params, ins, weights, op_state, ctx
+            )
+            if op_state:
+                op_state = dict(op_state)
+                aux = op_state.pop("aux_loss", None)
+                if aux is not None:
+                    aux_loss = aux_loss + aux
+                if op_state:
+                    cur = new_state.setdefault(node.name, {})
+                    cur.update(op_state)
+
+            for i, out in enumerate(outs):
+                if i < len(node.outputs):
+                    spec = node.outputs[i].partition_spec()
+                    if _spec_nontrivial(spec):
+                        out = jax.lax.with_sharding_constraint(
+                            out, NamedSharding(self.mesh, spec)
+                        )
+                vals[(node.guid, i)] = out
+
+        logits = vals[(self.logits_node.guid, 0)]
+        return logits, new_state, aux_loss
+
+    # ------------------------------------------------------------ steps
+
+    def build_train_step(self):
+        """One fused iteration: fwd + loss + bwd + optimizer + metrics.
+        Mirrors the traced loop of FFModel::fit (flexflow_cffi.py:2058-2100)
+        collapsed into a single XLA executable."""
+
+        def train_step(params, state, opt_slots, step, counters, rng, batch):
+            x_inputs, labels = batch
+
+            def loss_fn(p):
+                logits, new_state, aux = self._apply(
+                    p, state, x_inputs, training=True, rng=rng
+                )
+                l = loss_value(
+                    self.loss_type, logits, labels, self.last_op_is_softmax
+                )
+                return l + aux, (logits, new_state)
+
+            (lval, (logits, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            new_params, new_slots = self.optimizer.update(
+                grads, params, opt_slots, step
+            )
+            counters = self.metrics.compute(counters, logits, labels)
+            return new_params, new_state, new_slots, step + 1, counters, lval
+
+        self._train_step = jax.jit(train_step, donate_argnums=_donate_argnums((0, 1, 2, 3, 4)))
+        return self._train_step
+
+    def build_eval_step(self):
+        def eval_step(params, state, counters, batch):
+            x_inputs, labels = batch
+            logits, _, _ = self._apply(
+                params, state, x_inputs, training=False, rng=None
+            )
+            counters = self.metrics.compute(counters, logits, labels)
+            return counters
+
+        self._eval_step = jax.jit(eval_step, donate_argnums=_donate_argnums((2,)))
+        return self._eval_step
+
+    def build_forward(self):
+        def forward(params, state, x_inputs, training):
+            logits, new_state, _ = self._apply(
+                params, state, x_inputs, training=training, rng=jax.random.key(0)
+            )
+            return logits, new_state
+
+        self._forward_fn = jax.jit(forward, static_argnums=(3,))
+        return self._forward_fn
+
+    # ------------------------------------------------------------ data placement
+
+    def replicate(self, tree):
+        """Place leaves on the mesh (replicated) unless already mesh-placed.
+        All training state must live on the mesh before the first donated
+        step: donating a buffer that needs an implicit placement change
+        cannot reuse it and deadlocks XLA:CPU's in-process collectives.
+        Leaves that already carry a NamedSharding on this mesh (e.g. optimizer
+        slots built with zeros_like over sharded params) keep their sharding."""
+        repl = NamedSharding(self.mesh, PartitionSpec())
+
+        def place(x):
+            sh = getattr(x, "sharding", None)
+            if isinstance(sh, NamedSharding) and sh.mesh.shape == self.mesh.shape:
+                return x
+            return jax.device_put(x, repl)
+
+        return jax.tree.map(place, tree)
+
+    def shard_batch(self, arrays: dict, specs: dict):
+        out = {}
+        for name, arr in arrays.items():
+            spec = specs.get(name, PartitionSpec())
+            out[name] = jax.device_put(arr, NamedSharding(self.mesh, spec))
+        return out
+
+
+def _spec_nontrivial(spec: PartitionSpec) -> bool:
+    return any(entry is not None for entry in spec)
+
+
+def _donate_argnums(nums: tuple[int, ...]) -> tuple[int, ...]:
+    """Buffer donation saves HBM on TPU; on XLA:CPU (the virtual-mesh test
+    backend) donated buffers aliased into in-process collectives can deadlock
+    the rendezvous, so donation is disabled there."""
+    return nums if jax.default_backend() != "cpu" else ()

@@ -1,0 +1,48 @@
+"""Layer: the lazy frontend IR record.
+
+Reference: include/flexflow/layer.h:10 — untyped layer records created by
+FFModel builder calls before compile(); compile's
+create_operators_from_layers (src/runtime/model.cc:2785,2605) turns them into
+operators with ParallelTensors. Same two-phase life here.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional
+
+from .fftype import DataType, OperatorType
+
+_layer_guid = itertools.count(1000000)  # LAYER_GUID_FIRST_VALID
+
+
+class Layer:
+    def __init__(
+        self,
+        op_type: OperatorType,
+        params: Any,
+        inputs: list,
+        name: str = "",
+        data_type: DataType = DataType.DT_FLOAT,
+        initializers: Optional[dict] = None,
+    ):
+        self.layer_guid = next(_layer_guid)
+        self.op_type = op_type
+        self.params = params
+        self.inputs = list(inputs)
+        self.outputs = []
+        self.data_type = data_type
+        self.name = name or f"{op_type.name.lower()}_{self.layer_guid}"
+        # per-weight Initializer overrides, name → Initializer
+        self.initializers = initializers or {}
+
+    @property
+    def num_inputs(self) -> int:
+        return len(self.inputs)
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self.outputs)
+
+    def __repr__(self):
+        return f"Layer({self.name}, {self.op_type.name})"

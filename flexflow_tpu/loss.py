@@ -1,0 +1,47 @@
+"""Loss functions.
+
+Reference: include/flexflow/loss_functions.h:27-88 + src/loss_functions/
+loss_functions.cu. The reference implements loss as a single backward task
+writing logit gradients scaled by 1/batch (`scale_factor`); here the loss is
+a scalar-valued pure function and autodiff produces the same gradients — the
+CCE-after-softmax case yields the identical fused (probs - onehot)/batch
+gradient the reference hand-codes (loss_functions.cu:24-50).
+
+Auxiliary losses accumulated by ops (MoE load-balance) are added to the
+objective so their gradients flow, mirroring aggregate.cu's hand-injected
+balance gradient.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .fftype import LossType
+
+_EPS = 1e-8
+
+
+def loss_value(loss_type: LossType, logits, labels, last_op_is_softmax: bool):
+    """Scalar loss. `logits` is the final op output — probabilities if the
+    graph ends in softmax (the reference's convention for CCE losses)."""
+    lt = LossType(loss_type)
+    b = logits.shape[0]
+    if lt == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+        labels = labels.reshape(b, -1)[:, 0].astype(jnp.int32)
+        if last_op_is_softmax:
+            logp = jnp.log(logits + _EPS)
+        else:
+            logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    if lt == LossType.LOSS_CATEGORICAL_CROSSENTROPY:
+        logp = jnp.log(logits + _EPS) if last_op_is_softmax else jax.nn.log_softmax(logits, -1)
+        return -jnp.sum(labels * logp) / b
+    if lt == LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE:
+        return jnp.mean(jnp.sum((logits - labels) ** 2, axis=tuple(range(1, logits.ndim))))
+    if lt == LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE:
+        return jnp.sum((logits - labels) ** 2) / b
+    if lt == LossType.LOSS_IDENTITY:
+        # pass-through: gradient of ones/batch (loss_functions.cu identity_loss)
+        return jnp.sum(logits) / b
+    raise ValueError(f"unknown loss {loss_type}")

@@ -1,0 +1,139 @@
+"""Machine abstraction: device fleets, machine views, and the JAX mesh bridge.
+
+The reference models placement with `MachineView` — a strided view of a flat
+device grid assigned per PCG node (include/flexflow/machine_view.h:14-96) that
+the Legion mapper turns into task→GPU routing. On TPU the analogous object is
+an assignment of *parallel tensor dims to named mesh axes* over one global
+`jax.sharding.Mesh`: XLA/GSPMD then routes data movement over ICI/DCN instead
+of a task mapper. We keep `MachineView` (same fields, same hash role: it is
+the cost-model cache key and the identity of a placement) and add the bridge
+to `PartitionSpec`.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclass(frozen=True)
+class MachineView:
+    """Strided view over a flat device id space; parity with
+    machine_view.h:14-96. `dims[i]` = number of devices along view dim i."""
+
+    ndims: int
+    dims: tuple[int, ...]
+    strides: tuple[int, ...]
+    start_device_id: int = 0
+    device_type: str = "TPU"
+
+    @staticmethod
+    def make_1d(num_devices: int, start: int = 0, stride: int = 1) -> "MachineView":
+        return MachineView(1, (num_devices,), (stride,), start)
+
+    @property
+    def num_parts(self) -> int:
+        return int(np.prod(self.dims)) if self.dims else 1
+
+    def device_ids(self) -> list[int]:
+        ids = []
+        for idx in np.ndindex(*self.dims) if self.dims else [()]:
+            off = sum(i * s for i, s in zip(idx, self.strides))
+            ids.append(self.start_device_id + off)
+        return ids
+
+    def hash(self) -> int:
+        h = 17
+        for v in (self.ndims, self.start_device_id, *self.dims, *self.strides):
+            h = (h * 31 + v) & 0xFFFFFFFFFFFFFFFF
+        return h
+
+    def __repr__(self) -> str:
+        return (
+            f"MachineView(start={self.start_device_id}, dims={self.dims}, "
+            f"strides={self.strides})"
+        )
+
+
+@dataclass(frozen=True)
+class MachineResource:
+    """Resource slice the DP search splits (reference machine_view.h: the
+    MachineResource carried through graph_cost)."""
+
+    num_nodes: int
+    all_devices_per_node: int
+    available_devices_per_node: int
+    start_device_id: int = 0
+
+    @property
+    def num_devices(self) -> int:
+        return self.num_nodes * self.available_devices_per_node
+
+
+# Canonical mesh axis names. One global mesh; per-op placements are
+# PartitionSpecs over these axes. Degree-1 axes are harmless.
+AXIS_DATA = "data"      # batch / sample parallel
+AXIS_MODEL = "model"    # tensor/attribute/parameter parallel
+AXIS_PIPE = "pipe"      # pipeline stages
+AXIS_SEQ = "seq"        # sequence/context parallel (ring attention)
+AXIS_EXPERT = "expert"  # expert parallel (alias of model by default)
+
+DEFAULT_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ)
+
+
+@dataclass(frozen=True)
+class MeshShape:
+    """Declarative description of the global device mesh."""
+
+    axis_sizes: tuple[int, ...]
+    axis_names: tuple[str, ...] = DEFAULT_AXES
+
+    def __post_init__(self):
+        if len(self.axis_sizes) != len(self.axis_names):
+            raise ValueError(
+                f"axis_sizes {self.axis_sizes} and axis_names {self.axis_names} "
+                "must have equal rank"
+            )
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.axis_sizes)
+
+    @staticmethod
+    def data_parallel(num_devices: int) -> "MeshShape":
+        return MeshShape((num_devices, 1, 1, 1))
+
+
+def build_mesh(shape: MeshShape, devices: Optional[Sequence] = None) -> Mesh:
+    """Build the global mesh. Uses the classic `Mesh` constructor so axes are
+    Auto-typed (required for `with_sharding_constraint` pinning under GSPMD)."""
+    if devices is None:
+        devices = jax.devices()
+    n = shape.num_devices
+    if n > len(devices):
+        raise ValueError(
+            f"mesh needs {n} devices but only {len(devices)} available"
+        )
+    grid = np.array(devices[:n]).reshape(shape.axis_sizes)
+    return Mesh(grid, shape.axis_names)
+
+
+def spec_num_shards(mesh: Mesh, spec: PartitionSpec) -> int:
+    n = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            n *= mesh.shape[ax]
+    return n
+
+
+def named_sharding(mesh: Mesh, spec: PartitionSpec) -> NamedSharding:
+    return NamedSharding(mesh, spec)

@@ -1,0 +1,131 @@
+"""Metrics: PerfMetrics accumulation.
+
+Reference: include/flexflow/metrics_functions.h:44 + src/metrics_functions/ —
+per-shard GPU accumulation folded through a Legion future reduction
+(METRICS_COMP_TASK_ID / UPDATE_METRICS_TASK_ID). On TPU the per-shard compute
++ cross-replica reduction is a jnp reduction inside the jitted step (GSPMD
+inserts the psum); accumulation across iterations happens in a small on-device
+pytree, read back only when the user asks (get_metrics), so the train loop
+stays free of host syncs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+from .fftype import LossType, MetricsType
+
+
+@dataclass
+class Metrics:
+    loss_type: LossType
+    measure_accuracy: bool = False
+    measure_categorical_crossentropy: bool = False
+    measure_sparse_categorical_crossentropy: bool = False
+    measure_mean_squared_error: bool = False
+    measure_root_mean_squared_error: bool = False
+    measure_mean_absolute_error: bool = False
+
+    @staticmethod
+    def from_list(loss_type: LossType, metrics: list) -> "Metrics":
+        m = Metrics(loss_type)
+        for mt in metrics:
+            mt = MetricsType(mt)
+            if mt == MetricsType.METRICS_ACCURACY:
+                m.measure_accuracy = True
+            elif mt == MetricsType.METRICS_CATEGORICAL_CROSSENTROPY:
+                m.measure_categorical_crossentropy = True
+            elif mt == MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY:
+                m.measure_sparse_categorical_crossentropy = True
+            elif mt == MetricsType.METRICS_MEAN_SQUARED_ERROR:
+                m.measure_mean_squared_error = True
+            elif mt == MetricsType.METRICS_ROOT_MEAN_SQUARED_ERROR:
+                m.measure_root_mean_squared_error = True
+            elif mt == MetricsType.METRICS_MEAN_ABSOLUTE_ERROR:
+                m.measure_mean_absolute_error = True
+        return m
+
+    def zero_counters(self):
+        z = jnp.zeros((), jnp.float32)
+        return {
+            "train_all": z,
+            "train_correct": z,
+            "cce_loss": z,
+            "sparse_cce_loss": z,
+            "mse_loss": z,
+            "rmse_loss": z,
+            "mae_loss": z,
+        }
+
+    def compute(self, counters, logits, labels):
+        """One batch's contribution (metrics_functions.cu update kernels)."""
+        b = logits.shape[0]
+        new = dict(counters)
+        new["train_all"] = counters["train_all"] + b
+        eps = 1e-8
+        if self.measure_accuracy or self.measure_sparse_categorical_crossentropy:
+            sparse = labels.reshape(b, -1)[:, 0].astype(jnp.int32)
+        if self.measure_accuracy:
+            pred = jnp.argmax(logits.reshape(b, -1), axis=-1).astype(jnp.int32)
+            new["train_correct"] = counters["train_correct"] + jnp.sum(
+                (pred == sparse).astype(jnp.float32)
+            )
+        if self.measure_sparse_categorical_crossentropy:
+            logp = jnp.log(logits.reshape(b, -1) + eps)
+            new["sparse_cce_loss"] = counters["sparse_cce_loss"] - jnp.sum(
+                jnp.take_along_axis(logp, sparse[:, None], axis=-1)
+            )
+        if self.measure_categorical_crossentropy:
+            new["cce_loss"] = counters["cce_loss"] - jnp.sum(
+                labels * jnp.log(logits + eps)
+            )
+        if self.measure_mean_squared_error or self.measure_root_mean_squared_error:
+            se = jnp.sum((logits - labels) ** 2)
+            new["mse_loss"] = counters["mse_loss"] + se
+        if self.measure_mean_absolute_error:
+            new["mae_loss"] = counters["mae_loss"] + jnp.sum(jnp.abs(logits - labels))
+        return new
+
+
+class PerfMetrics:
+    """Host-side view of accumulated counters (reference PerfMetrics struct)."""
+
+    def __init__(self, counters, metrics: Metrics):
+        self._c = {k: float(v) for k, v in counters.items()}
+        self._m = metrics
+
+    @property
+    def train_all(self) -> int:
+        return int(self._c["train_all"])
+
+    @property
+    def train_correct(self) -> int:
+        return int(self._c["train_correct"])
+
+    def get_accuracy(self) -> float:
+        return self.train_correct / max(1, self.train_all)
+
+    def get_mean_loss(self) -> float:
+        n = max(1, self.train_all)
+        if self._m.measure_sparse_categorical_crossentropy:
+            return self._c["sparse_cce_loss"] / n
+        if self._m.measure_categorical_crossentropy:
+            return self._c["cce_loss"] / n
+        return self._c["mse_loss"] / n
+
+    def __repr__(self):
+        n = max(1, self.train_all)
+        parts = [f"train_all={self.train_all}"]
+        if self._m.measure_accuracy:
+            parts.append(f"accuracy={100.0 * self.get_accuracy():.2f}%")
+        if self._m.measure_sparse_categorical_crossentropy:
+            parts.append(f"sparse_cce={self._c['sparse_cce_loss'] / n:.4f}")
+        if self._m.measure_categorical_crossentropy:
+            parts.append(f"cce={self._c['cce_loss'] / n:.4f}")
+        if self._m.measure_mean_squared_error:
+            parts.append(f"mse={self._c['mse_loss'] / n:.4f}")
+        if self._m.measure_mean_absolute_error:
+            parts.append(f"mae={self._c['mae_loss'] / n:.4f}")
+        return "[" + " ".join(parts) + "]"

@@ -1,0 +1,143 @@
+"""Multi-head attention.
+
+Reference: src/ops/attention.cc (926 LoC) + attention.cu wrapping
+`cudnnMultiHeadAttnForward` — a monolithic vendor kernel with weights packed
+into a single tensor. TPU-native design instead expresses attention as
+projections (MXU GEMMs) + a scaled-dot-product core with three interchangeable
+implementations selected per placement:
+
+  - "xla":    plain einsum softmax(QK^T)V — XLA fuses well for short seqs
+  - "flash":  Pallas blockwise-softmax kernel (kernels/flash_attention.py) —
+    O(seq) memory, used on the real chip for long sequences
+  - "ring":   shard_map ring attention over the `seq` mesh axis
+    (parallel/ring_attention.py) — the long-context path the reference lacks
+    (SURVEY §5: no ring/Ulysses in FlexFlow)
+
+Head-parallelism (the reference's attribute-parallel attention rewrite,
+substitution.cc:create_partition_attention_combine) maps to sharding the head
+dim of the projection weights over the `model` axis.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..fftype import DataType, OperatorType as OT
+from .base import OpDef, WeightSpec, register_op
+
+
+@dataclass(frozen=True)
+class MultiHeadAttentionParams:
+    embed_dim: int
+    num_heads: int
+    kdim: int = 0  # 0 → embed_dim
+    vdim: int = 0
+    dropout: float = 0.0
+    use_bias: bool = True
+    add_bias_kv: bool = False
+    add_zero_attn: bool = False
+    causal: bool = False  # TPU-native addition (reference cuDNN op is unmasked)
+    impl: str = "xla"  # xla | flash | ring
+
+
+def _mha_dims(p: MultiHeadAttentionParams):
+    kdim = p.kdim or p.embed_dim
+    vdim = p.vdim or p.embed_dim
+    return kdim, vdim
+
+
+def _mha_infer(p: MultiHeadAttentionParams, in_shapes):
+    q, k, v = in_shapes
+    return [(q[0], q[1], p.embed_dim)]
+
+
+def _mha_weights(p: MultiHeadAttentionParams, in_shapes):
+    q, k, v = in_shapes
+    kdim, vdim = _mha_dims(p)
+    # per-head projection sizes follow attention.cc:70-80 (qProjSize = kdim/heads)
+    ws = [
+        WeightSpec("wq", (q[-1], p.embed_dim), DataType.DT_FLOAT),
+        WeightSpec("wk", (k[-1], p.embed_dim), DataType.DT_FLOAT),
+        WeightSpec("wv", (v[-1], p.embed_dim), DataType.DT_FLOAT),
+        WeightSpec("wo", (p.embed_dim, p.embed_dim), DataType.DT_FLOAT),
+    ]
+    if p.use_bias:
+        ws += [
+            WeightSpec("bq", (p.embed_dim,), DataType.DT_FLOAT, "zeros"),
+            WeightSpec("bk", (p.embed_dim,), DataType.DT_FLOAT, "zeros"),
+            WeightSpec("bv", (p.embed_dim,), DataType.DT_FLOAT, "zeros"),
+            WeightSpec("bo", (p.embed_dim,), DataType.DT_FLOAT, "zeros"),
+        ]
+    return ws
+
+
+def sdpa_xla(q, k, v, *, causal: bool, scale: float):
+    """Reference-semantics scaled dot-product attention, einsum form.
+    q,k,v: (batch, heads, seq, head_dim)."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _mha_forward(p: MultiHeadAttentionParams, inputs, weights, state, ctx):
+    q_in, k_in, v_in = inputs
+    H = p.num_heads
+    E = p.embed_dim
+    hd = E // H
+
+    def proj(x, w, b):
+        y = jnp.dot(x, w.astype(x.dtype), preferred_element_type=jnp.float32).astype(x.dtype)
+        if b is not None:
+            y = y + b
+        return y
+
+    q = proj(q_in, weights["wq"], weights.get("bq"))
+    k = proj(k_in, weights["wk"], weights.get("bk"))
+    v = proj(v_in, weights["wv"], weights.get("bv"))
+
+    def split_heads(x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, H, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    scale = 1.0 / math.sqrt(hd)
+
+    if p.impl == "flash":
+        from ..kernels.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, causal=p.causal, scale=scale)
+    elif p.impl == "ring":
+        from ..parallel.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v, causal=p.causal, scale=scale)
+    else:
+        out = sdpa_xla(q, k, v, causal=p.causal, scale=scale)
+
+    b, _, s, _ = out.shape
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, E)
+    y = proj(out, weights["wo"], weights.get("bo"))
+    return [y], state
+
+
+def _mha_flops(p: MultiHeadAttentionParams, in_shapes, out_shapes):
+    q, k, v = in_shapes
+    b, sq, dq = q
+    sk = k[1]
+    E = p.embed_dim
+    proj = 2.0 * b * (sq * dq * E + sk * k[2] * E + sk * v[2] * E + sq * E * E)
+    attn = 2.0 * b * p.num_heads * sq * sk * (E // p.num_heads) * 2
+    return proj + attn
+
+
+register_op(
+    OpDef(OT.OP_MULTIHEAD_ATTENTION, _mha_infer, _mha_forward, _mha_weights, _mha_flops)
+)

@@ -1,0 +1,94 @@
+"""Operator definition framework.
+
+The reference implements each operator as {FFModel builder, Op subclass with
+Legion task launchers, Params struct, OpMeta, CUDA kernels}
+(pattern documented at src/ops/linear.cc). On TPU the per-device kernel is XLA
+HLO traced from a pure function, and the Legion launcher disappears: an
+operator here is
+
+  - a frozen Params dataclass (the analog of `*_params.h`, used for op dedup
+    and as the simulator cache key — reference include/flexflow/operator_params.h)
+  - shape/weight inference (the analog of the builder's output-shape logic)
+  - a pure `forward` (params, inputs, weights, state) → (outputs, state)
+    traced under jit; autodiff replaces hand-written backward tasks
+  - an analytic flop/byte count used by the Unity cost model in place of
+    on-device `measure_operator_cost` when microbenchmarks are disabled.
+
+State is threaded functionally for the few stateful ops (BatchNorm running
+stats, Cache) — the TPU equivalent of OpMeta mutable fields.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+from ..fftype import DataType, OperatorType
+
+
+@dataclass(frozen=True)
+class WeightSpec:
+    """Declares one trainable (or stateful) tensor of an operator."""
+
+    name: str
+    shape: tuple[int, ...]
+    dtype: DataType
+    initializer: str = "glorot_uniform"  # glorot_uniform|zeros|ones|normal|uniform
+    trainable: bool = True
+
+
+@dataclass
+class OpContext:
+    """Per-call execution context (the slim analog of OpMeta)."""
+
+    training: bool = True
+    rng: Any = None  # jax PRNG key folded per-op by the executor
+    seq_length: int = -1
+    profiling: bool = False
+
+
+class OpDef:
+    """Registry entry for one OperatorType."""
+
+    def __init__(
+        self,
+        op_type: OperatorType,
+        infer_shapes: Callable,  # (params, in_shapes) -> list[tuple]
+        forward: Callable,  # (params, inputs, weights, state, ctx) -> (outputs, state)
+        weights: Optional[Callable] = None,  # (params, in_shapes) -> list[WeightSpec]
+        flops: Optional[Callable] = None,  # (params, in_shapes, out_shapes) -> float
+        num_outputs: int = 1,
+    ):
+        self.op_type = op_type
+        self.infer_shapes = infer_shapes
+        self.forward = forward
+        self.weights = weights or (lambda params, in_shapes: [])
+        self.flops = flops or _default_flops
+        self.num_outputs = num_outputs
+
+
+def _default_flops(params, in_shapes, out_shapes) -> float:
+    # elementwise-ish default: one flop per output element
+    total = 0
+    for s in out_shapes:
+        total += math.prod(s) if s else 1
+    return float(total)
+
+
+_REGISTRY: dict[OperatorType, OpDef] = {}
+
+
+def register_op(op_def: OpDef):
+    _REGISTRY[op_def.op_type] = op_def
+    return op_def
+
+
+def get_op_def(op_type: OperatorType) -> OpDef:
+    if op_type not in _REGISTRY:
+        raise KeyError(f"no OpDef registered for {op_type!r}")
+    return _REGISTRY[op_type]
+
+
+def registered_ops() -> dict[OperatorType, OpDef]:
+    return dict(_REGISTRY)

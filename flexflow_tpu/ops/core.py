@@ -1,0 +1,418 @@
+"""Dense-compute operators: the MXU-bound core of the framework.
+
+Reference kernels: src/ops/kernels/linear_kernels.cu (cuBLAS GEMM + cuDNN
+activation), src/ops/conv_2d.cc + conv_2d_kernels.cu (cuDNN conv),
+src/ops/pool_2d.cc, src/ops/batch_norm.cu, src/ops/layer_norm.cu (Welford),
+src/ops/attention.cu (cudnnMultiHeadAttnForward), src/ops/embedding.cc,
+src/ops/batch_matmul.cc, src/ops/kernels/softmax.cu, src/ops/dropout.cc.
+
+TPU mapping: GEMMs/convs lower straight onto the MXU via jnp.dot/lax.conv
+with bf16 accumulation policy controlled by FFConfig
+(`allow_tensor_op_math_conversion` ≙ the reference's tensor-op math flag);
+normalizations and activations are VPU ops that XLA fuses into the adjacent
+GEMM's epilogue. Layouts: user-facing shapes keep the reference's NCHW
+convention; XLA repacks internally for the TPU's native tiling.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..fftype import ActiMode, AggrMode, DataType, OperatorType as OT, PoolType, RegularizerMode
+from .base import OpDef, WeightSpec, register_op
+
+
+def apply_activation(x, activation: ActiMode):
+    if activation == ActiMode.AC_MODE_NONE:
+        return x
+    if activation == ActiMode.AC_MODE_RELU:
+        return jax.nn.relu(x)
+    if activation == ActiMode.AC_MODE_SIGMOID:
+        return jax.nn.sigmoid(x)
+    if activation == ActiMode.AC_MODE_TANH:
+        return jnp.tanh(x)
+    if activation == ActiMode.AC_MODE_GELU:
+        return jax.nn.gelu(x, approximate=False)
+    raise ValueError(f"unknown activation {activation}")
+
+
+# ---------------------------------------------------------------- Linear
+
+@dataclass(frozen=True)
+class LinearParams:
+    out_channels: int
+    use_bias: bool = True
+    activation: ActiMode = ActiMode.AC_MODE_NONE
+    data_type: DataType = DataType.DT_FLOAT
+    kernel_reg_type: RegularizerMode = RegularizerMode.REG_MODE_NONE
+    kernel_reg_lambda: float = 0.0
+
+
+def _linear_infer(p: LinearParams, in_shapes):
+    (x,) = in_shapes
+    return [tuple(x[:-1]) + (p.out_channels,)]
+
+
+def _linear_weights(p: LinearParams, in_shapes):
+    in_dim = in_shapes[0][-1]
+    ws = [WeightSpec("kernel", (in_dim, p.out_channels), p.data_type, "glorot_uniform")]
+    if p.use_bias:
+        ws.append(WeightSpec("bias", (p.out_channels,), p.data_type, "zeros"))
+    return ws
+
+
+def _linear_forward(p: LinearParams, inputs, weights, state, ctx):
+    (x,) = inputs
+    y = jnp.dot(x, weights["kernel"], preferred_element_type=jnp.float32)
+    y = y.astype(x.dtype)
+    if p.use_bias:
+        y = y + weights["bias"]
+    return [apply_activation(y, p.activation)], state
+
+
+def _linear_flops(p: LinearParams, in_shapes, out_shapes):
+    x = in_shapes[0]
+    return 2.0 * math.prod(x) * p.out_channels
+
+
+register_op(OpDef(OT.OP_LINEAR, _linear_infer, _linear_forward, _linear_weights, _linear_flops))
+
+
+# ---------------------------------------------------------------- Conv2D
+
+@dataclass(frozen=True)
+class Conv2DParams:
+    out_channels: int
+    kernel_h: int
+    kernel_w: int
+    stride_h: int
+    stride_w: int
+    padding_h: int
+    padding_w: int
+    groups: int = 1
+    use_bias: bool = True
+    activation: ActiMode = ActiMode.AC_MODE_NONE
+
+
+def _conv2d_out_hw(p: Conv2DParams, h, w):
+    oh = (h + 2 * p.padding_h - p.kernel_h) // p.stride_h + 1
+    ow = (w + 2 * p.padding_w - p.kernel_w) // p.stride_w + 1
+    return oh, ow
+
+
+def _conv2d_infer(p: Conv2DParams, in_shapes):
+    n, c, h, w = in_shapes[0]
+    oh, ow = _conv2d_out_hw(p, h, w)
+    return [(n, p.out_channels, oh, ow)]
+
+
+def _conv2d_weights(p: Conv2DParams, in_shapes):
+    c = in_shapes[0][1]
+    ws = [
+        WeightSpec(
+            "kernel",
+            (p.out_channels, c // p.groups, p.kernel_h, p.kernel_w),
+            DataType.DT_FLOAT,
+            "glorot_uniform",
+        )
+    ]
+    if p.use_bias:
+        ws.append(WeightSpec("bias", (p.out_channels,), DataType.DT_FLOAT, "zeros"))
+    return ws
+
+
+def _conv2d_forward(p: Conv2DParams, inputs, weights, state, ctx):
+    (x,) = inputs
+    y = jax.lax.conv_general_dilated(
+        x,
+        weights["kernel"].astype(x.dtype),
+        window_strides=(p.stride_h, p.stride_w),
+        padding=[(p.padding_h, p.padding_h), (p.padding_w, p.padding_w)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=p.groups,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    if p.use_bias:
+        y = y + weights["bias"][None, :, None, None]
+    return [apply_activation(y, p.activation)], state
+
+
+def _conv2d_flops(p: Conv2DParams, in_shapes, out_shapes):
+    n, c, h, w = in_shapes[0]
+    _, oc, oh, ow = out_shapes[0]
+    return 2.0 * n * oc * oh * ow * (c // p.groups) * p.kernel_h * p.kernel_w
+
+
+register_op(OpDef(OT.OP_CONV2D, _conv2d_infer, _conv2d_forward, _conv2d_weights, _conv2d_flops))
+
+
+# ---------------------------------------------------------------- Pool2D
+
+@dataclass(frozen=True)
+class Pool2DParams:
+    kernel_h: int
+    kernel_w: int
+    stride_h: int
+    stride_w: int
+    padding_h: int
+    padding_w: int
+    pool_type: PoolType = PoolType.POOL_MAX
+    activation: ActiMode = ActiMode.AC_MODE_NONE
+
+
+def _pool2d_infer(p: Pool2DParams, in_shapes):
+    n, c, h, w = in_shapes[0]
+    oh = (h + 2 * p.padding_h - p.kernel_h) // p.stride_h + 1
+    ow = (w + 2 * p.padding_w - p.kernel_w) // p.stride_w + 1
+    return [(n, c, oh, ow)]
+
+
+def _pool2d_forward(p: Pool2DParams, inputs, weights, state, ctx):
+    (x,) = inputs
+    pads = ((0, 0), (0, 0), (p.padding_h, p.padding_h), (p.padding_w, p.padding_w))
+    dims = (1, 1, p.kernel_h, p.kernel_w)
+    strides = (1, 1, p.stride_h, p.stride_w)
+    if p.pool_type == PoolType.POOL_MAX:
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        y = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pads)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+        # cuDNN CUDNN_POOLING_AVERAGE_COUNT_INCLUDE_PADDING semantics
+        y = summed / (p.kernel_h * p.kernel_w)
+    return [apply_activation(y, p.activation)], state
+
+
+register_op(OpDef(OT.OP_POOL2D, _pool2d_infer, _pool2d_forward))
+
+
+# ---------------------------------------------------------------- Flat
+
+def _flat_infer(p, in_shapes):
+    n = in_shapes[0][0]
+    return [(n, math.prod(in_shapes[0][1:]))]
+
+
+def _flat_forward(p, inputs, weights, state, ctx):
+    (x,) = inputs
+    return [x.reshape(x.shape[0], -1)], state
+
+
+register_op(OpDef(OT.OP_FLAT, _flat_infer, _flat_forward))
+
+
+# ---------------------------------------------------------------- BatchNorm
+
+@dataclass(frozen=True)
+class BatchNormParams:
+    relu: bool = True
+    momentum: float = 0.1
+    eps: float = 1e-5
+
+
+def _bn_infer(p, in_shapes):
+    return [in_shapes[0]]
+
+
+def _bn_weights(p: BatchNormParams, in_shapes):
+    c = in_shapes[0][1]
+    return [
+        WeightSpec("scale", (c,), DataType.DT_FLOAT, "ones"),
+        WeightSpec("bias", (c,), DataType.DT_FLOAT, "zeros"),
+        WeightSpec("running_mean", (c,), DataType.DT_FLOAT, "zeros", trainable=False),
+        WeightSpec("running_var", (c,), DataType.DT_FLOAT, "ones", trainable=False),
+    ]
+
+
+def _bn_forward(p: BatchNormParams, inputs, weights, state, ctx):
+    (x,) = inputs
+    axes = (0, 2, 3)
+    if ctx.training:
+        mean = jnp.mean(x, axes)
+        var = jnp.var(x, axes)
+        state = dict(state or {})
+        state["running_mean"] = (
+            (1 - p.momentum) * weights["running_mean"] + p.momentum * mean
+        )
+        state["running_var"] = (
+            (1 - p.momentum) * weights["running_var"] + p.momentum * var
+        )
+    else:
+        mean = weights["running_mean"]
+        var = weights["running_var"]
+    inv = jax.lax.rsqrt(var + p.eps)
+    y = (x - mean[None, :, None, None]) * inv[None, :, None, None]
+    y = y * weights["scale"][None, :, None, None] + weights["bias"][None, :, None, None]
+    if p.relu:
+        y = jax.nn.relu(y)
+    return [y], state
+
+
+register_op(OpDef(OT.OP_BATCHNORM, _bn_infer, _bn_forward, _bn_weights))
+
+
+# ---------------------------------------------------------------- LayerNorm
+
+@dataclass(frozen=True)
+class LayerNormParams:
+    axes: tuple[int, ...]
+    elementwise_affine: bool = True
+    eps: float = 1e-5
+
+
+def _ln_infer(p, in_shapes):
+    return [in_shapes[0]]
+
+
+def _ln_weights(p: LayerNormParams, in_shapes):
+    if not p.elementwise_affine:
+        return []
+    shape = tuple(in_shapes[0][a] for a in p.axes)
+    return [
+        WeightSpec("scale", shape, DataType.DT_FLOAT, "ones"),
+        WeightSpec("bias", shape, DataType.DT_FLOAT, "zeros"),
+    ]
+
+
+def _ln_forward(p: LayerNormParams, inputs, weights, state, ctx):
+    (x,) = inputs
+    axes = tuple(a % x.ndim for a in p.axes)
+    mean = jnp.mean(x, axes, keepdims=True)
+    var = jnp.var(x, axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + p.eps)
+    if p.elementwise_affine:
+        bshape = [x.shape[a] if a in axes else 1 for a in range(x.ndim)]
+        y = y * weights["scale"].reshape(bshape) + weights["bias"].reshape(bshape)
+    return [y], state
+
+
+register_op(OpDef(OT.OP_LAYERNORM, _ln_infer, _ln_forward, _ln_weights))
+
+
+# ---------------------------------------------------------------- Softmax
+
+@dataclass(frozen=True)
+class SoftmaxParams:
+    dim: int = -1
+
+
+def _softmax_infer(p, in_shapes):
+    return [in_shapes[0]]
+
+
+def _softmax_forward(p: SoftmaxParams, inputs, weights, state, ctx):
+    (x,) = inputs
+    return [jax.nn.softmax(x, axis=p.dim)], state
+
+
+register_op(OpDef(OT.OP_SOFTMAX, _softmax_infer, _softmax_forward))
+
+
+# ---------------------------------------------------------------- Dropout
+
+@dataclass(frozen=True)
+class DropoutParams:
+    rate: float
+    seed: int = 0
+
+
+def _dropout_infer(p, in_shapes):
+    return [in_shapes[0]]
+
+
+def _dropout_forward(p: DropoutParams, inputs, weights, state, ctx):
+    (x,) = inputs
+    if not ctx.training or p.rate <= 0.0:
+        return [x], state
+    keep = 1.0 - p.rate
+    mask = jax.random.bernoulli(ctx.rng, keep, x.shape)
+    return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)], state
+
+
+register_op(OpDef(OT.OP_DROPOUT, _dropout_infer, _dropout_forward))
+
+
+# ---------------------------------------------------------------- BatchMatmul
+
+@dataclass(frozen=True)
+class BatchMatmulParams:
+    a_seq_length_dim: int = -1
+    b_seq_length_dim: int = -1
+
+
+def _bmm_infer(p, in_shapes):
+    a, b = in_shapes
+    if a[:-2] != b[:-2]:
+        raise ValueError(f"batch dims mismatch: {a} vs {b}")
+    if a[-1] != b[-2]:
+        raise ValueError(f"contraction mismatch: {a} vs {b}")
+    return [tuple(a[:-2]) + (a[-2], b[-1])]
+
+
+def _bmm_forward(p: BatchMatmulParams, inputs, weights, state, ctx):
+    a, b = inputs
+    if ctx.seq_length >= 0:
+        # truncated-sequence batches (FFIterationConfig::seq_length,
+        # reference include/flexflow/config.h:162-167)
+        if p.a_seq_length_dim >= 0:
+            a = jax.lax.slice_in_dim(a, 0, ctx.seq_length, axis=p.a_seq_length_dim)
+        if p.b_seq_length_dim >= 0:
+            b = jax.lax.slice_in_dim(b, 0, ctx.seq_length, axis=p.b_seq_length_dim)
+    y = jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    return [y], state
+
+
+def _bmm_flops(p, in_shapes, out_shapes):
+    a, b = in_shapes
+    return 2.0 * math.prod(out_shapes[0]) * a[-1]
+
+
+register_op(OpDef(OT.OP_BATCHMATMUL, _bmm_infer, _bmm_forward, flops=_bmm_flops))
+
+
+# ---------------------------------------------------------------- Embedding
+
+@dataclass(frozen=True)
+class EmbeddingParams:
+    num_entries: int
+    out_channels: int
+    aggr: AggrMode = AggrMode.AGGR_MODE_NONE
+    data_type: DataType = DataType.DT_FLOAT
+
+
+def _embedding_infer(p: EmbeddingParams, in_shapes):
+    x = in_shapes[0]
+    if p.aggr == AggrMode.AGGR_MODE_NONE:
+        return [tuple(x) + (p.out_channels,)]
+    return [tuple(x[:-1]) + (p.out_channels,)]
+
+
+def _embedding_weights(p: EmbeddingParams, in_shapes):
+    return [
+        WeightSpec(
+            "kernel", (p.num_entries, p.out_channels), p.data_type, "glorot_uniform"
+        )
+    ]
+
+
+def _embedding_forward(p: EmbeddingParams, inputs, weights, state, ctx):
+    (ids,) = inputs
+    table = weights["kernel"]
+    # gather rides the VPU; for giant tables sharded over the model axis GSPMD
+    # turns this into an all-to-all — same role as the reference's custom
+    # scatter/gather kernels (src/ops/kernels/embedding_kernels.cu)
+    emb = jnp.take(table, ids.astype(jnp.int32), axis=0)
+    if p.aggr == AggrMode.AGGR_MODE_SUM:
+        emb = jnp.sum(emb, axis=-2)
+    elif p.aggr == AggrMode.AGGR_MODE_AVG:
+        emb = jnp.mean(emb, axis=-2)
+    return [emb], state
+
+
+register_op(
+    OpDef(OT.OP_EMBEDDING, _embedding_infer, _embedding_forward, _embedding_weights)
+)

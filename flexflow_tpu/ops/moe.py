@@ -1,0 +1,211 @@
+"""Mixture-of-Experts operators: Group_by, Aggregate, AggregateSpec, Cache.
+
+Reference: src/ops/group_by.cc/.cu (token→expert scatter with capacity factor
+alpha), src/ops/aggregate.cc/.cu (gate-weighted combine + load-balance term in
+backward), src/ops/aggregate_spec.cc (speculative variant with replicated
+labels), src/ops/cache.cc (cross-batch activation cache with staleness score,
+include/flexflow/ops/cache.h:14-65).
+
+TPU design notes:
+- The reference's CUDA kernels do data-dependent scatter/gather. Under jit we
+  need static shapes, so expert buffers are padded to the same
+  `capacity = ceil(alpha * k * batch / n)` the reference uses — its alpha
+  capacity factor exists for exactly this reason (static allocation).
+- Token ranking within an expert is a cumsum over a one-hot routing matrix —
+  all dense VPU math, no serialization; overflow tokens are dropped exactly
+  like the reference (group_by.cu drops rows beyond expert capacity).
+- Both Group_by and Aggregate derive slots from the same deterministic
+  (sample-major) ordering so they agree without communicating, mirroring the
+  reference pair.
+- The load-balance gradient the reference injects in aggregate's backward
+  (lambda_bal) is exposed here as an auxiliary loss accumulated into op state
+  ("aux_loss"); the loss module adds it to the scalar objective so autodiff
+  produces the same gate gradients.
+- Expert parallelism = sharding the stacked expert dim over the `expert`/
+  `model` mesh axis; the gather in aggregate then lowers to an all-to-all.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..fftype import DataType, OperatorType as OT
+from .base import OpDef, WeightSpec, register_op
+
+
+def expert_capacity(n: int, k: int, batch: int, alpha: float) -> int:
+    return max(1, int(math.ceil(alpha * k * batch / n)))
+
+
+def _routing_slots(assign, n: int, capacity: int):
+    """assign: (batch, k) int expert ids → (slot, valid) each (batch, k).
+
+    slot[i,j] = rank of token (i,j) among tokens routed to assign[i,j], in
+    sample-major order; valid = rank < capacity."""
+    b, k = assign.shape
+    flat = assign.reshape(-1).astype(jnp.int32)  # (b*k,)
+    onehot = jax.nn.one_hot(flat, n, dtype=jnp.int32)  # (b*k, n)
+    ranks = jnp.cumsum(onehot, axis=0) - onehot  # rank among same-expert tokens
+    slot = jnp.take_along_axis(ranks, flat[:, None], axis=1)[:, 0]
+    valid = slot < capacity
+    return slot.reshape(b, k), valid.reshape(b, k)
+
+
+# ---------------------------------------------------------------- Group_by
+
+@dataclass(frozen=True)
+class GroupByParams:
+    n: int
+    alpha: float
+
+
+def _group_by_infer(p: GroupByParams, in_shapes):
+    data, assign = in_shapes
+    batch, dim = data
+    k = assign[1]
+    cap = expert_capacity(p.n, k, batch, p.alpha)
+    return [(cap, dim) for _ in range(p.n)]
+
+
+def _group_by_forward(p: GroupByParams, inputs, weights, state, ctx):
+    data, assign = inputs
+    batch, dim = data.shape
+    k = assign.shape[1]
+    cap = expert_capacity(p.n, k, batch, p.alpha)
+    slot, valid = _routing_slots(assign, p.n, cap)
+
+    # scatter token rows into (n, cap, dim); dropped tokens land in a trash slot
+    flat_assign = assign.reshape(-1).astype(jnp.int32)
+    flat_slot = jnp.where(valid.reshape(-1), slot.reshape(-1), cap)
+    token_rows = jnp.repeat(data, k, axis=0) if k > 1 else data
+    buffers = jnp.zeros((p.n, cap + 1, dim), dtype=data.dtype)
+    buffers = buffers.at[flat_assign, flat_slot].set(token_rows)
+    outs = [buffers[e, :cap] for e in range(p.n)]
+    return outs, state
+
+
+register_op(
+    OpDef(OT.OP_GROUP_BY, _group_by_infer, _group_by_forward, num_outputs=-1)
+)
+
+
+# ---------------------------------------------------------------- Aggregate
+
+@dataclass(frozen=True)
+class AggregateParams:
+    n: int
+    lambda_bal: float = 0.0
+
+
+def _aggregate_infer(p: AggregateParams, in_shapes):
+    # inputs: gate_preds (b,k), gate_assign (b,k), true_gate_assign (b,k),
+    #         full_gate_grads (b,n), exp_pred_1..n (cap, out_dim)
+    gate_preds = in_shapes[0]
+    out_dim = in_shapes[4][1]
+    return [(gate_preds[0], out_dim)]
+
+
+def _aggregate_forward(p: AggregateParams, inputs, weights, state, ctx):
+    gate_preds, gate_assign = inputs[0], inputs[1]
+    exp_preds = jnp.stack(inputs[4 : 4 + p.n])  # (n, cap, dim)
+    b, k = gate_assign.shape
+    cap = exp_preds.shape[1]
+    slot, valid = _routing_slots(gate_assign, p.n, cap)
+
+    e_idx = gate_assign.astype(jnp.int32)  # (b, k)
+    rows = exp_preds[e_idx, jnp.where(valid, slot, 0)]  # (b, k, dim)
+    rows = jnp.where(valid[..., None], rows, 0.0)
+    out = jnp.einsum("bk,bkd->bd", gate_preds.astype(rows.dtype), rows)
+
+    if p.lambda_bal > 0.0:
+        # load-balance auxiliary objective (reference injects the equivalent
+        # gradient by hand in aggregate.cu backward): mean tokens-per-expert
+        # × mean gate probability per expert, Shazeer-style.
+        full_gate = inputs[3]  # (b, n) softmax over all experts
+        counts = jnp.sum(
+            jax.nn.one_hot(e_idx.reshape(-1), p.n, dtype=full_gate.dtype), axis=0
+        )
+        frac_tokens = counts / (b * k)
+        frac_probs = jnp.mean(full_gate, axis=0)
+        aux = p.n * jnp.sum(frac_tokens * frac_probs)
+        state = dict(state or {})
+        state["aux_loss"] = p.lambda_bal * aux
+    return [out], state
+
+
+register_op(OpDef(OT.OP_AGGREGATE, _aggregate_infer, _aggregate_forward))
+
+
+# ---------------------------------------------------------------- AggregateSpec
+
+@dataclass(frozen=True)
+class AggregateSpecParams:
+    n: int
+    lambda_bal: float = 0.0
+
+
+def _agg_spec_infer(p: AggregateSpecParams, in_shapes):
+    # speculative variant: emits per-token-copy rows (k*b, dim) so each
+    # expert's prediction is scored against (replicated) labels — see
+    # model.cc:2875 replicating labels when last op is OP_AGG_SPEC
+    gate_preds = in_shapes[0]
+    out_dim = in_shapes[4][1]
+    b, k = gate_preds
+    return [(k * b, out_dim)]
+
+
+def _agg_spec_forward(p: AggregateSpecParams, inputs, weights, state, ctx):
+    gate_preds, gate_assign = inputs[0], inputs[1]
+    exp_preds = jnp.stack(inputs[4 : 4 + p.n])
+    b, k = gate_assign.shape
+    cap = exp_preds.shape[1]
+    slot, valid = _routing_slots(gate_assign, p.n, cap)
+    e_idx = gate_assign.astype(jnp.int32)
+    rows = exp_preds[e_idx, jnp.where(valid, slot, 0)]
+    rows = jnp.where(valid[..., None], rows, 0.0)  # (b, k, dim)
+    out = rows.transpose(1, 0, 2).reshape(k * b, -1)
+    return [out], state
+
+
+register_op(OpDef(OT.OP_AGG_SPEC, _agg_spec_infer, _agg_spec_forward))
+
+
+# ---------------------------------------------------------------- Cache
+
+@dataclass(frozen=True)
+class CacheParams:
+    num_batches: int
+    data_type: DataType = DataType.DT_FLOAT
+
+
+def _cache_infer(p: CacheParams, in_shapes):
+    return [in_shapes[0]]
+
+
+def _cache_weights(p: CacheParams, in_shapes):
+    return [
+        WeightSpec(
+            "cached", in_shapes[0], p.data_type, "zeros", trainable=False
+        )
+    ]
+
+
+def _cache_forward(p: CacheParams, inputs, weights, state, ctx):
+    (x,) = inputs
+    state = dict(state or {})
+    if ctx.training:
+        # training: pass through and refresh the cache (reference
+        # cache_update task); staleness scoring is host-side via
+        # RecompileState triggers.
+        state["cached"] = x.astype(jnp.dtype(weights["cached"].dtype))
+        return [x], state
+    return [weights["cached"].astype(x.dtype)], state
+
+
+register_op(
+    OpDef(OT.OP_CACHE, _cache_infer, _cache_forward, _cache_weights)
+)

@@ -1,0 +1,149 @@
+"""Parallel Computation Graph (PCG).
+
+Reference: include/flexflow/graph.h — `Graph` of `Node{guid, Op*}` with
+multi-edges carrying (srcOp, dstOp, srcIdx, dstIdx); the IR on which both
+Unity search (substitutions + DP) and compile-time op reconstruction operate.
+Compute ops and parallelization ops are both first-class nodes.
+
+This module is pure data + graph algorithms (topo order, hashing, transitive
+reduction); execution is in executor.py, search in search/.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..fftype import OperatorType, PARALLEL_OP_TYPES
+from ..machine import MachineView
+from ..ops.base import OpDef, WeightSpec, get_op_def
+from ..tensor import ParallelTensor, ParallelTensorShape
+
+_node_guid = itertools.count(5000000)  # NODE_GUID_FIRST_VALID
+
+
+@dataclass(frozen=True)
+class Edge:
+    """src node guid, dst node guid, src output idx, dst input idx."""
+
+    src: int
+    dst: int
+    src_idx: int = 0
+    dst_idx: int = 0
+
+
+class OpNode:
+    """One PCG node: operator instance with parallel tensors attached."""
+
+    def __init__(
+        self,
+        op_type: OperatorType,
+        params: Any,
+        name: str = "",
+        layer_guid: int = -1,
+        initializers: Optional[dict] = None,
+    ):
+        self.guid = next(_node_guid)
+        self.op_type = op_type
+        self.params = params
+        self.name = name or f"{op_type.name.lower()}_{self.guid}"
+        self.layer_guid = layer_guid
+        self.initializers = initializers or {}
+        self.inputs: list[ParallelTensor] = []
+        self.outputs: list[ParallelTensor] = []
+        self.weight_specs: list[WeightSpec] = []
+        self.machine_view: Optional[MachineView] = None
+        # weight name → PartitionSpec (placement of the parameter itself);
+        # default replicated — the reference's weight regions mapped by
+        # map_weight (model.cc)
+        self.weight_axes: dict[str, Any] = {}
+
+    @property
+    def op_def(self) -> OpDef:
+        return get_op_def(self.op_type)
+
+    @property
+    def is_parallel_op(self) -> bool:
+        return self.op_type in PARALLEL_OP_TYPES
+
+    def __repr__(self):
+        return f"OpNode({self.name})"
+
+
+class Graph:
+    """PCG: nodes + explicit edges. Node identity is the guid."""
+
+    def __init__(self):
+        self.nodes: dict[int, OpNode] = {}
+        self.in_edges: dict[int, list[Edge]] = {}
+        self.out_edges: dict[int, list[Edge]] = {}
+
+    def add_node(self, node: OpNode) -> OpNode:
+        self.nodes[node.guid] = node
+        self.in_edges.setdefault(node.guid, [])
+        self.out_edges.setdefault(node.guid, [])
+        return node
+
+    def add_edge(self, src: OpNode, dst: OpNode, src_idx: int = 0, dst_idx: int = 0):
+        e = Edge(src.guid, dst.guid, src_idx, dst_idx)
+        self.in_edges[dst.guid].append(e)
+        self.out_edges[src.guid].append(e)
+
+    def remove_node(self, node: OpNode):
+        for e in list(self.in_edges.get(node.guid, [])):
+            self.out_edges[e.src].remove(e)
+        for e in list(self.out_edges.get(node.guid, [])):
+            self.in_edges[e.dst].remove(e)
+        self.in_edges.pop(node.guid, None)
+        self.out_edges.pop(node.guid, None)
+        self.nodes.pop(node.guid, None)
+
+    def sources(self) -> list[OpNode]:
+        return [n for g, n in self.nodes.items() if not self.in_edges[g]]
+
+    def sinks(self) -> list[OpNode]:
+        return [n for g, n in self.nodes.items() if not self.out_edges[g]]
+
+    def topo_order(self) -> list[OpNode]:
+        indeg = {g: len(es) for g, es in self.in_edges.items()}
+        # deterministic: process in guid order among ready nodes
+        ready = sorted(g for g, d in indeg.items() if d == 0)
+        order = []
+        while ready:
+            g = ready.pop(0)
+            order.append(self.nodes[g])
+            for e in self.out_edges[g]:
+                indeg[e.dst] -= 1
+                if indeg[e.dst] == 0:
+                    # insert keeping ready sorted
+                    import bisect
+
+                    bisect.insort(ready, e.dst)
+        if len(order) != len(self.nodes):
+            raise ValueError("graph has a cycle")
+        return order
+
+    def producer(self, node: OpNode, dst_idx: int) -> tuple[OpNode, int]:
+        for e in self.in_edges[node.guid]:
+            if e.dst_idx == dst_idx:
+                return self.nodes[e.src], e.src_idx
+        raise KeyError(f"{node} has no producer for input {dst_idx}")
+
+    def hash(self) -> int:
+        """Structural hash for search dedup (reference Graph::hash)."""
+        h = 0
+        node_hash = {}
+        for n in self.topo_order():
+            nh = hash((n.op_type, repr(n.params)))
+            for e in sorted(
+                self.in_edges[n.guid], key=lambda e: (e.dst_idx, e.src_idx)
+            ):
+                nh = nh * 31 + node_hash[e.src] * 7 + e.src_idx + e.dst_idx * 131
+                nh &= 0xFFFFFFFFFFFFFFFF
+            node_hash[n.guid] = nh
+            h = (h * 17 + nh) & 0xFFFFFFFFFFFFFFFF
+        return h
+
+    def __len__(self):
+        return len(self.nodes)

@@ -1,0 +1,216 @@
+"""Tensor IR: lazy frontend tensors and parallel tensors.
+
+Mirrors the reference's two-level tensor world
+(include/flexflow/tensor.h, include/flexflow/parallel_tensor.h:36-198):
+
+- `Tensor`: plain shape+dtype handle produced by layer-builder calls before
+  `compile()`; owns no data.
+- `ParallelTensor`: post-compile tensor whose dims carry parallelization state
+  (`ParallelDim {size, degree, parallel_idx, is_replica_dim}`). In the
+  reference the degree/parallel_idx drive Legion partitions; here they drive a
+  `PartitionSpec` over the global TPU mesh, and data movement is performed by
+  XLA collectives over ICI instead of region copies.
+
+Unlike the reference we do not materialize replica dims as extra array axes at
+runtime: replication is expressed by *not* sharding a dim and partial-sum
+state by GSPMD's psum insertion. The replica dim still exists in the IR (shape
+level) so Unity-style rewrites stay expressible.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from jax.sharding import PartitionSpec
+
+from .fftype import DataType, ParameterSyncType
+from .machine import MachineView
+
+MAX_TENSOR_DIM = 5
+
+_tensor_guid = itertools.count(3000000)  # TENSOR_GUID_FIRST_VALID
+_parallel_tensor_guid = itertools.count(4000000)
+
+
+class Tensor:
+    """Lazy frontend tensor handle (reference tensor.h TensorBase).
+
+    `dims` are stored outer-to-inner (NumPy order), unlike the reference's
+    Legion-order innermost-first; the Python API of the reference also
+    presents NumPy order, so user-visible semantics match.
+    """
+
+    def __init__(
+        self,
+        dims: tuple[int, ...],
+        dtype: DataType,
+        owner_layer=None,
+        owner_idx: int = 0,
+        name: str = "",
+        create_gradients: bool = True,
+    ):
+        self.tensor_guid = next(_tensor_guid)
+        self.dims = tuple(int(d) for d in dims)
+        self.dtype = DataType(dtype)
+        self.owner_layer = owner_layer
+        self.owner_idx = owner_idx
+        self.name = name or f"tensor_{self.tensor_guid}"
+        self.create_gradients = create_gradients
+
+    @property
+    def num_dims(self) -> int:
+        return len(self.dims)
+
+    def get_shape(self) -> tuple[int, ...]:
+        return self.dims
+
+    def __repr__(self):
+        return f"Tensor({self.name}, dims={self.dims}, dtype={self.dtype.name})"
+
+
+@dataclass(frozen=True)
+class ParallelDim:
+    """Per-dim parallelization state (parallel_tensor.h:36-71).
+
+    size: logical extent of the dim (replica dims: size == degree)
+    degree: number of shards along this dim
+    parallel_idx: index into the op's machine-view dims (-1 if unsharded)
+    is_replica_dim: true for dims that exist only to count replicas
+    """
+
+    size: int
+    degree: int = 1
+    parallel_idx: int = -1
+    is_replica_dim: bool = False
+
+    def __post_init__(self):
+        if self.degree < 1:
+            raise ValueError(f"degree must be >= 1, got {self.degree}")
+        if not self.is_replica_dim and self.size % self.degree != 0:
+            raise ValueError(
+                f"dim size {self.size} not divisible by degree {self.degree}"
+            )
+
+
+@dataclass(frozen=True)
+class ParallelTensorShape:
+    """Shape + parallelization annotation; the value the PCG/search reasons
+    about (parallel_tensor.h:96-135)."""
+
+    dims: tuple[ParallelDim, ...]
+    dtype: DataType
+
+    @staticmethod
+    def from_shape(shape: tuple[int, ...], dtype: DataType) -> "ParallelTensorShape":
+        return ParallelTensorShape(tuple(ParallelDim(int(s)) for s in shape), dtype)
+
+    @property
+    def logical_shape(self) -> tuple[int, ...]:
+        """Shape without replica dims — what the runtime array looks like
+        globally."""
+        return tuple(d.size for d in self.dims if not d.is_replica_dim)
+
+    @property
+    def num_replica_dims(self) -> int:
+        return sum(1 for d in self.dims if d.is_replica_dim)
+
+    @property
+    def total_degree(self) -> int:
+        deg = 1
+        for d in self.dims:
+            deg *= d.degree
+        return deg
+
+    def piece_shape(self) -> tuple[int, ...]:
+        """Per-device shard shape (logical dims only)."""
+        return tuple(
+            d.size // d.degree for d in self.dims if not d.is_replica_dim
+        )
+
+    def num_elements(self) -> int:
+        n = 1
+        for s in self.logical_shape:
+            n *= s
+        return n
+
+    def piece_elements(self) -> int:
+        n = 1
+        for s in self.piece_shape():
+            n *= s
+        return n
+
+    def with_degree(self, dim: int, degree: int) -> "ParallelTensorShape":
+        dims = list(self.dims)
+        dims[dim] = replace(dims[dim], degree=degree)
+        return ParallelTensorShape(tuple(dims), self.dtype)
+
+    def __repr__(self):
+        parts = []
+        for d in self.dims:
+            tag = "R" if d.is_replica_dim else ""
+            parts.append(f"{d.size}{tag}/{d.degree}" if d.degree > 1 or d.is_replica_dim else str(d.size))
+        return f"PTShape[{' x '.join(parts)}, {self.dtype.name}]"
+
+
+class ParallelTensor:
+    """Post-compile tensor: parallel shape + mesh-axis assignment + (at run
+    time) the jax.Array it names (parallel_tensor.h:139-198).
+
+    `axis_assignment[i]` is the tuple of mesh axis names sharding dim i
+    (empty tuple = replicated along that dim). The PartitionSpec fed to
+    `with_sharding_constraint` / `device_put` is derived from it, restricted
+    to logical (non-replica) dims.
+    """
+
+    def __init__(
+        self,
+        shape: ParallelTensorShape,
+        name: str = "",
+        sync_type: ParameterSyncType = ParameterSyncType.NONE,
+        create_gradients: bool = True,
+    ):
+        self.parallel_tensor_guid = next(_parallel_tensor_guid)
+        self.shape = shape
+        self.name = name or f"ptensor_{self.parallel_tensor_guid}"
+        self.sync_type = sync_type
+        self.create_gradients = create_gradients
+        self.axis_assignment: tuple[tuple[str, ...], ...] = tuple(
+            () for _ in shape.dims
+        )
+        self.machine_view: Optional[MachineView] = None
+        self.owner_op = None
+        self.owner_idx: int = 0
+
+    @property
+    def dtype(self) -> DataType:
+        return self.shape.dtype
+
+    def assign_axes(self, assignment: tuple[tuple[str, ...], ...]):
+        if len(assignment) != len(self.shape.dims):
+            raise ValueError(
+                f"assignment rank {len(assignment)} != tensor rank "
+                f"{len(self.shape.dims)}"
+            )
+        self.axis_assignment = tuple(tuple(a) for a in assignment)
+
+    def partition_spec(self) -> PartitionSpec:
+        """PartitionSpec over logical dims only (replica dims replicate by
+        omission — GSPMD treats unnamed axes as replicated)."""
+        entries = []
+        for d, axes in zip(self.shape.dims, self.axis_assignment):
+            if d.is_replica_dim:
+                continue
+            if not axes:
+                entries.append(None)
+            elif len(axes) == 1:
+                entries.append(axes[0])
+            else:
+                entries.append(tuple(axes))
+        while entries and entries[-1] is None:
+            entries.pop()
+        return PartitionSpec(*entries)
+
+    def __repr__(self):
+        return f"ParallelTensor({self.name}, {self.shape}, spec={self.partition_spec()})"

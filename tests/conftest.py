@@ -1,0 +1,39 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference tests multi-GPU only on real hardware (SURVEY §4); we do better
+by unit-testing all SPMD logic on XLA's host platform with
+--xla_force_host_platform_device_count=8, so sharding/search/collective code
+is exercised in CI without TPUs.
+
+The container's sitecustomize registers the axon TPU plugin and forces
+jax_platforms="axon,cpu" via jax.config (which overrides env vars), so we
+override it back through jax.config before any backend initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from jax._src import xla_bridge as _xb
+
+if _xb.backends_are_initialized():  # pragma: no cover - defensive
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+
+import numpy as np
+import pytest
+
+assert jax.devices()[0].platform == "cpu", jax.devices()
+assert jax.device_count() == 8, jax.devices()
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
