@@ -126,6 +126,7 @@ class Executor:
                 rng=_stable_fold(rng, node.name) if rng is not None else None,
                 seq_length=seq_length,
                 profiling=self.config.profiling,
+                mesh=self.mesh,
             )
             op_state = new_state.get(node.name)
             outs, op_state = node.op_def.forward(

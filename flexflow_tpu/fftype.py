@@ -145,6 +145,8 @@ class OperatorType(enum.IntEnum):
     OP_CACHE = enum.auto()
     OP_AGGREGATE = enum.auto()
     OP_AGG_SPEC = enum.auto()
+    # TPU-native addition: stacked-experts op enabling expert-axis sharding
+    OP_EXPERTS = enum.auto()
     OP_RESHAPE = enum.auto()
     OP_REVERSE = enum.auto()
     OP_TRANSPOSE = enum.auto()

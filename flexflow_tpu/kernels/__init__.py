@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference's L2 is hand-written CUDA/cuDNN kernels (SURVEY §1). On TPU,
+XLA emits MXU-tiled code for nearly everything; Pallas kernels exist only
+where fusion across the softmax (attention) or data-dependent routing (MoE)
+beats XLA's default lowering.
+"""
+
+from .flash_attention import flash_attention

@@ -364,10 +364,16 @@ class FFModel:
         add_zero_attn: bool = False,
         kernel_initializer: Optional[Initializer] = None,
         causal: bool = False,
+        impl: str = "xla",
         name: str = "",
     ) -> Tensor:
+        if impl not in ("xla", "flash", "ring"):
+            raise ValueError(
+                f"multihead_attention impl must be xla|flash|ring, got {impl!r}"
+            )
         p = MultiHeadAttentionParams(embed_dim, num_heads, kdim, vdim, dropout,
-                                     bias, add_bias_kv, add_zero_attn, causal)
+                                     bias, add_bias_kv, add_zero_attn, causal,
+                                     impl)
         inits = {}
         if kernel_initializer is not None:
             for w in ("wq", "wk", "wv", "wo"):
@@ -464,6 +470,29 @@ class FFModel:
         return self._add_layer(OT.OP_CACHE, p, [input], name,
                                data_type=input.dtype).outputs[0]
 
+    def experts(
+        self,
+        input: Tensor,
+        gate_values: Tensor,
+        gate_assign: Tensor,
+        num_experts: int,
+        hidden_size: int,
+        alpha: float = 1.0,
+        lambda_bal: float = 0.0,
+        use_bias: bool = True,
+        activation: str = "relu",
+        name: str = "",
+    ) -> Tensor:
+        """Fused stacked-experts op (TPU-native MoE fast path; shard its
+        kernel dim 0 over the expert mesh axis for expert parallelism)."""
+        from .ops import ExpertsParams
+
+        p = ExpertsParams(num_experts, hidden_size, alpha, lambda_bal,
+                          use_bias, activation)
+        return self._add_layer(OT.OP_EXPERTS, p,
+                               [input, gate_values, gate_assign], name,
+                               data_type=input.dtype).outputs[0]
+
     def moe(
         self,
         input: Tensor,
@@ -472,12 +501,17 @@ class FFModel:
         expert_hidden_size: int,
         alpha: float,
         lambda_bal: float,
+        fused: bool = False,
     ) -> Tensor:
         """MoE composite (reference src/ops/moe.cc:20-50): gate dense → topk →
-        group_by → per-expert dense → aggregate."""
+        group_by → per-expert dense → aggregate. With fused=True the
+        group_by/expert/aggregate trio is the single stacked Experts op."""
         gate_preds = self.dense(input, num_exp, ActiMode.AC_MODE_RELU)
         gate_probs = self.softmax(gate_preds)
         topk_values, topk_assign = self.top_k(gate_probs, num_select)
+        if fused:
+            return self.experts(input, topk_values, topk_assign, num_exp,
+                                expert_hidden_size, alpha, lambda_bal)
         expert_inputs = self.group_by(input, topk_assign, num_exp, alpha)
         expert_outputs = []
         for ei in expert_inputs:
@@ -485,6 +519,47 @@ class FFModel:
             expert_outputs.append(h)
         agg_inputs = [topk_values, topk_assign, topk_assign, gate_probs] + expert_outputs
         return self.aggregate(agg_inputs, num_exp, lambda_bal)
+
+    # ------------------------------------------------ parallel ops
+    # (reference src/parallel_ops/*; inserted explicitly or by Unity search)
+
+    def repartition(self, input: Tensor, dim: int, degree: int,
+                    name: str = "") -> Tensor:
+        from .parallel import RepartitionParams
+
+        p = RepartitionParams(dim, degree)
+        return self._add_layer(OT.OP_REPARTITION, p, [input], name,
+                               data_type=input.dtype).outputs[0]
+
+    def combine(self, input: Tensor, dim: int, degree: int,
+                name: str = "") -> Tensor:
+        from .parallel import CombineParams
+
+        p = CombineParams(dim, degree)
+        return self._add_layer(OT.OP_COMBINE, p, [input], name,
+                               data_type=input.dtype).outputs[0]
+
+    def replicate(self, input: Tensor, degree: int, name: str = "") -> Tensor:
+        from .parallel import ReplicateParams
+
+        p = ReplicateParams(degree)
+        return self._add_layer(OT.OP_REPLICATE, p, [input], name,
+                               data_type=input.dtype).outputs[0]
+
+    def reduction(self, input: Tensor, degree: int, name: str = "") -> Tensor:
+        from .parallel import ReductionParams
+
+        p = ReductionParams(degree)
+        return self._add_layer(OT.OP_REDUCTION, p, [input], name,
+                               data_type=input.dtype).outputs[0]
+
+    # ================================================== strategy
+
+    def set_strategy(self, strategy):
+        """Install a parallelization strategy (a parallel.Strategy or raw
+        override dict) applied on top of the data-parallel default at
+        compile. The `--import-strategy` analog (model.cc:3599-3608)."""
+        self._strategy = getattr(strategy, "overrides", strategy)
 
     # ================================================== compile
 
@@ -564,25 +639,37 @@ class FFModel:
         over the `data` axis, weights replicated — the reference's
         data-parallel fallback (graph.cc:1939-1964). A searched or imported
         strategy overrides per-node specs via self._strategy."""
+        from .parallel.ops import derive_parallel_assignment
+
         data_axis_sz = self.mesh.shape[AXIS_DATA]
         for node in self.graph.topo_order():
-            for pt in node.outputs:
-                dims = pt.shape.dims
-                assignment = [()] * len(dims)
-                if (
-                    data_axis_sz > 1
-                    and len(dims) > 0
-                    and not node.is_parallel_op
-                    and dims[0].size % data_axis_sz == 0
-                    and not _is_expert_buffer(node)
-                ):
-                    assignment[0] = (AXIS_DATA,)
-                pt.assign_axes(tuple(assignment))
-            if self._strategy and node.name in self._strategy:
-                ov = self._strategy[node.name]
-                for i, spec_axes in ov.get("outputs", {}).items():
-                    node.outputs[i].assign_axes(spec_axes)
-                node.weight_axes.update(ov.get("weights", {}))
+            ov = (self._strategy or {}).get(node.name, {})
+            if node.is_parallel_op and node.inputs:
+                # explicit parallel op: output placement derived from the
+                # input's placement + the op's dim/degree params (unless the
+                # strategy pins it explicitly below)
+                if 0 not in ov.get("outputs", {}):
+                    node.outputs[0].assign_axes(
+                        derive_parallel_assignment(
+                            node.op_type, node.params,
+                            node.inputs[0].axis_assignment, self.mesh,
+                        )
+                    )
+            else:
+                for pt in node.outputs:
+                    dims = pt.shape.dims
+                    assignment = [()] * len(dims)
+                    if (
+                        data_axis_sz > 1
+                        and len(dims) > 0
+                        and dims[0].size % data_axis_sz == 0
+                        and not _is_expert_buffer(node)
+                    ):
+                        assignment[0] = (AXIS_DATA,)
+                    pt.assign_axes(tuple(assignment))
+            for i, spec_axes in ov.get("outputs", {}).items():
+                node.outputs[i].assign_axes(spec_axes)
+            node.weight_axes.update(ov.get("weights", {}))
 
     # ================================================== training API
 

@@ -20,7 +20,13 @@ from .core import (
 )
 from .attention import MultiHeadAttentionParams
 from .elementwise import ElementBinaryParams, ElementUnaryParams
-from .moe import AggregateParams, AggregateSpecParams, CacheParams, GroupByParams
+from .moe import (
+    AggregateParams,
+    AggregateSpecParams,
+    CacheParams,
+    ExpertsParams,
+    GroupByParams,
+)
 from .shape_ops import (
     CastParams,
     ConcatParams,

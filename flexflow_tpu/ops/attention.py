@@ -118,7 +118,8 @@ def _mha_forward(p: MultiHeadAttentionParams, inputs, weights, state, ctx):
     elif p.impl == "ring":
         from ..parallel.ring_attention import ring_attention
 
-        out = ring_attention(q, k, v, causal=p.causal, scale=scale)
+        out = ring_attention(q, k, v, causal=p.causal, scale=scale,
+                             mesh=ctx.mesh)
     else:
         out = sdpa_xla(q, k, v, causal=p.causal, scale=scale)
 

@@ -46,6 +46,7 @@ class OpContext:
     rng: Any = None  # jax PRNG key folded per-op by the executor
     seq_length: int = -1
     profiling: bool = False
+    mesh: Any = None  # global jax Mesh (for ops lowering to shard_map)
 
 
 class OpDef:

@@ -55,6 +55,30 @@ def _routing_slots(assign, n: int, capacity: int):
     return slot.reshape(b, k), valid.reshape(b, k)
 
 
+
+
+def _scatter_to_buffers(data, assign, n: int, cap: int, slot, valid):
+    """Scatter token rows into stacked (n, cap, dim) expert buffers; dropped
+    tokens land in a trash slot (group_by.cu semantics). Shared by Group_by
+    and the fused Experts op so routing can never desynchronize."""
+    k = assign.shape[1]
+    flat_assign = assign.reshape(-1).astype(jnp.int32)
+    flat_slot = jnp.where(valid.reshape(-1), slot.reshape(-1), cap)
+    token_rows = jnp.repeat(data, k, axis=0) if k > 1 else data
+    buffers = jnp.zeros((n, cap + 1, data.shape[1]), dtype=data.dtype)
+    buffers = buffers.at[flat_assign, flat_slot].set(token_rows)
+    return buffers[:, :cap]
+
+
+def _gather_expert_rows(stacked, assign, slot, valid):
+    """Gather each token's expert output row from stacked (n, cap, dim);
+    dropped tokens read as zeros (aggregate.cu semantics). Returns
+    (rows (b, k, dim), expert_idx (b, k))."""
+    e_idx = assign.astype(jnp.int32)
+    rows = stacked[e_idx, jnp.where(valid, slot, 0)]
+    return jnp.where(valid[..., None], rows, 0.0), e_idx
+
+
 # ---------------------------------------------------------------- Group_by
 
 @dataclass(frozen=True)
@@ -77,14 +101,8 @@ def _group_by_forward(p: GroupByParams, inputs, weights, state, ctx):
     k = assign.shape[1]
     cap = expert_capacity(p.n, k, batch, p.alpha)
     slot, valid = _routing_slots(assign, p.n, cap)
-
-    # scatter token rows into (n, cap, dim); dropped tokens land in a trash slot
-    flat_assign = assign.reshape(-1).astype(jnp.int32)
-    flat_slot = jnp.where(valid.reshape(-1), slot.reshape(-1), cap)
-    token_rows = jnp.repeat(data, k, axis=0) if k > 1 else data
-    buffers = jnp.zeros((p.n, cap + 1, dim), dtype=data.dtype)
-    buffers = buffers.at[flat_assign, flat_slot].set(token_rows)
-    outs = [buffers[e, :cap] for e in range(p.n)]
+    buffers = _scatter_to_buffers(data, assign, p.n, cap, slot, valid)
+    outs = [buffers[e] for e in range(p.n)]
     return outs, state
 
 
@@ -115,10 +133,7 @@ def _aggregate_forward(p: AggregateParams, inputs, weights, state, ctx):
     b, k = gate_assign.shape
     cap = exp_preds.shape[1]
     slot, valid = _routing_slots(gate_assign, p.n, cap)
-
-    e_idx = gate_assign.astype(jnp.int32)  # (b, k)
-    rows = exp_preds[e_idx, jnp.where(valid, slot, 0)]  # (b, k, dim)
-    rows = jnp.where(valid[..., None], rows, 0.0)
+    rows, e_idx = _gather_expert_rows(exp_preds, gate_assign, slot, valid)
     out = jnp.einsum("bk,bkd->bd", gate_preds.astype(rows.dtype), rows)
 
     if p.lambda_bal > 0.0:
@@ -164,9 +179,7 @@ def _agg_spec_forward(p: AggregateSpecParams, inputs, weights, state, ctx):
     b, k = gate_assign.shape
     cap = exp_preds.shape[1]
     slot, valid = _routing_slots(gate_assign, p.n, cap)
-    e_idx = gate_assign.astype(jnp.int32)
-    rows = exp_preds[e_idx, jnp.where(valid, slot, 0)]
-    rows = jnp.where(valid[..., None], rows, 0.0)  # (b, k, dim)
+    rows, _ = _gather_expert_rows(exp_preds, gate_assign, slot, valid)
     out = rows.transpose(1, 0, 2).reshape(k * b, -1)
     return [out], state
 
@@ -208,4 +221,97 @@ def _cache_forward(p: CacheParams, inputs, weights, state, ctx):
 
 register_op(
     OpDef(OT.OP_CACHE, _cache_infer, _cache_forward, _cache_weights)
+)
+
+
+# ---------------------------------------------------------------- Experts
+# TPU-native addition (no analog in the reference training snapshot): the
+# group_by → per-expert dense → aggregate trio fused into ONE op over a
+# *stacked* expert weight (n, in, hidden). Why: separate per-expert Dense
+# layers can only be expert-parallelized by placing whole ops on different
+# devices (the reference's attribute-parallel machine views); a stacked
+# weight makes expert parallelism a plain sharding of dim 0 over the
+# `expert` mesh axis, so GSPMD lowers the token exchange to all_to_all over
+# ICI. Routing math (capacity, slot ranking, dropping) matches
+# group_by.cu/aggregate.cu semantics exactly.
+
+@dataclass(frozen=True)
+class ExpertsParams:
+    n: int
+    hidden_size: int
+    alpha: float = 1.0
+    lambda_bal: float = 0.0
+    use_bias: bool = True
+    activation: str = "relu"  # relu | gelu | none
+
+
+def _experts_infer(p: ExpertsParams, in_shapes):
+    data = in_shapes[0]  # (b, d)
+    return [(data[0], p.hidden_size)]
+
+
+def _experts_weights(p: ExpertsParams, in_shapes):
+    d = in_shapes[0][1]
+    ws = [WeightSpec("kernel", (p.n, d, p.hidden_size), DataType.DT_FLOAT)]
+    if p.use_bias:
+        ws.append(
+            WeightSpec("bias", (p.n, p.hidden_size), DataType.DT_FLOAT, "zeros")
+        )
+    return ws
+
+
+def _experts_forward(p: ExpertsParams, inputs, weights, state, ctx):
+    data, gate_values, gate_assign = inputs  # (b,d), (b,k), (b,k)
+    b, d = data.shape
+    k = gate_assign.shape[1]
+    cap = expert_capacity(p.n, k, b, p.alpha)
+    slot, valid = _routing_slots(gate_assign, p.n, cap)
+    buffers = _scatter_to_buffers(data, gate_assign, p.n, cap, slot, valid)
+
+    # stacked expert dense — one batched MXU matmul over all experts
+    kern = weights["kernel"].astype(buffers.dtype)
+    h = jnp.einsum("ncd,ndh->nch", buffers, kern)
+    if p.use_bias:
+        h = h + weights["bias"].astype(h.dtype)[:, None, :]
+    if p.activation == "relu":
+        h = jax.nn.relu(h)
+    elif p.activation == "gelu":
+        h = jax.nn.gelu(h)
+
+    # gather back + gate-weighted combine (aggregate semantics)
+    rows, e_idx = _gather_expert_rows(h, gate_assign, slot, valid)
+    out = jnp.einsum("bk,bkh->bh", gate_values.astype(rows.dtype), rows)
+
+    if p.lambda_bal > 0.0:
+        counts = jnp.sum(
+            jax.nn.one_hot(e_idx.reshape(-1), p.n, dtype=jnp.float32), axis=0
+        )
+        frac_tokens = counts / (b * k)
+        # gate_values are the top-k probabilities; renormalize as proxy
+        probs = jnp.zeros((b, p.n), jnp.float32)
+        probs = probs.at[jnp.arange(b)[:, None], e_idx].set(
+            gate_values.astype(jnp.float32)
+        )
+        frac_probs = jnp.mean(probs, axis=0)
+        aux = p.n * jnp.sum(frac_tokens * frac_probs)
+        state = dict(state or {})
+        state["aux_loss"] = p.lambda_bal * aux
+    return [out], state
+
+
+def _experts_flops(p: ExpertsParams, in_shapes, out_shapes):
+    b, d = in_shapes[0]
+    k = in_shapes[2][1]
+    cap = expert_capacity(p.n, k, b, p.alpha)
+    return 2.0 * p.n * cap * d * p.hidden_size
+
+
+register_op(
+    OpDef(
+        OT.OP_EXPERTS,
+        _experts_infer,
+        _experts_forward,
+        _experts_weights,
+        _experts_flops,
+    )
 )

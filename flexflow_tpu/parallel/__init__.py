@@ -1,0 +1,24 @@
+"""Parallelization package: parallel operators, strategies, ring attention.
+
+The reference keeps parallelism first-class as PCG operators
+(src/parallel_ops/*, SURVEY §2.3); here the same four ops exist as IR nodes
+whose runtime lowering is GSPMD sharding constraints (collectives over ICI
+inserted by XLA), and strategies are per-node mesh-axis assignments.
+"""
+
+from .ops import (
+    CombineParams,
+    FusedParallelOpParams,
+    ParallelOpInfo,
+    PipelineParams,
+    ReductionParams,
+    RepartitionParams,
+    ReplicateParams,
+    apply_parallel_op_shape,
+)
+from .strategies import (
+    Strategy,
+    expert_parallel_moe,
+    megatron_transformer,
+    sequence_parallel_attention,
+)

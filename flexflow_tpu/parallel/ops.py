@@ -1,0 +1,216 @@
+"""Parallelization operators: Repartition, Combine, Replicate, Reduction,
+FusedParallelOp, Pipeline.
+
+Reference: src/parallel_ops/{partition,combine,replicate,reduction,
+fused_parallel_op}.cc — each is a PCG node that changes a tensor's
+parallelization state (per-dim degree / replica dims) and whose execution is
+data movement (Legion partition copies, SURVEY §2.3).
+
+TPU-native lowering: the *runtime* body of every parallel op is the identity —
+the executor pins each node's output with `with_sharding_constraint`, so the
+degree change becomes an XLA collective over ICI exactly where the reference
+would launch a partition-copy task:
+
+  Repartition (degree up on dim d)  → resharding: dynamic-slice / all_to_all
+  Combine     (degree down on dim d)→ all_gather along the freed mesh axis
+  Replicate   (new replica dim)     → broadcast (implicit in GSPMD)
+  Reduction   (drop replica dim)    → psum / reduce_scatter (inserted by XLA
+                                      when the producer's contraction was
+                                      sharded over the reduced axis)
+
+The *IR-level* shape transform (apply_parallel_op_shape) is what Unity search
+rewrites operate on, and the cost model charges the communication bytes these
+transforms imply (see search/cost_model.py).
+
+The reference leaves OP_PIPELINE as an enum with no implementation
+(ffconst.h:159, SURVEY §2.3); here PipelineParams marks a stage boundary that
+the executor may schedule with `jax.lax.ppermute`-based 1F1B (exceeding
+reference capability when enabled).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Tuple
+
+from ..fftype import OperatorType as OT
+from ..tensor import ParallelDim, ParallelTensorShape
+from ..ops.base import OpDef, register_op
+
+
+@dataclass(frozen=True)
+class RepartitionParams:
+    """Increase partition degree along `dim` by `degree`×
+    (partition.cc:132 create_input_partition)."""
+
+    dim: int
+    degree: int
+
+
+@dataclass(frozen=True)
+class CombineParams:
+    """Decrease partition degree along `dim` by `degree`× (combine.cc:135)."""
+
+    dim: int
+    degree: int
+
+
+@dataclass(frozen=True)
+class ReplicateParams:
+    """Add a replica dim of extent `degree` (replicate.cc)."""
+
+    degree: int
+
+
+@dataclass(frozen=True)
+class ReductionParams:
+    """Sum-reduce a replica dim of extent `degree` (reduction.cc: forward
+    kernel sums num_replicas slices — here XLA's psum)."""
+
+    degree: int
+
+
+@dataclass(frozen=True)
+class PipelineParams:
+    """Stage boundary marker. OP_PIPELINE is enum-only in the reference."""
+
+    stage: int = 0
+
+
+@dataclass(frozen=True)
+class ParallelOpInfo:
+    op_type: OT
+    dim: int
+    degree: int
+
+
+@dataclass(frozen=True)
+class FusedParallelOpParams:
+    """Sequence of parallel transforms fused into one resharding
+    (fused_parallel_op.cc)."""
+
+    ops: Tuple[ParallelOpInfo, ...]
+
+
+def apply_parallel_op_shape(
+    shape: ParallelTensorShape, op_type: OT, params
+) -> ParallelTensorShape:
+    """IR shape transform for one parallel op (search rewrites use this)."""
+    dims = list(shape.dims)
+    if op_type == OT.OP_REPARTITION:
+        d = dims[params.dim]
+        dims[params.dim] = replace(d, degree=d.degree * params.degree)
+    elif op_type == OT.OP_COMBINE:
+        d = dims[params.dim]
+        if d.degree % params.degree != 0:
+            raise ValueError(
+                f"combine degree {params.degree} does not divide {d.degree}"
+            )
+        dims[params.dim] = replace(d, degree=d.degree // params.degree)
+    elif op_type == OT.OP_REPLICATE:
+        dims.append(
+            ParallelDim(
+                size=params.degree, degree=params.degree, is_replica_dim=True
+            )
+        )
+    elif op_type == OT.OP_REDUCTION:
+        for i in range(len(dims) - 1, -1, -1):
+            if dims[i].is_replica_dim:
+                if dims[i].degree != params.degree:
+                    raise ValueError(
+                        f"reduction degree {params.degree} != replica degree "
+                        f"{dims[i].degree}"
+                    )
+                dims.pop(i)
+                break
+        else:
+            raise ValueError("reduction with no replica dim")
+    elif op_type == OT.OP_FUSED_PARALLEL:
+        s = shape
+        for info in params.ops:
+            sub = _INFO_PARAMS[info.op_type](info)
+            s = apply_parallel_op_shape(s, info.op_type, sub)
+        return s
+    elif op_type == OT.OP_PIPELINE:
+        pass
+    else:
+        raise ValueError(f"not a parallel op: {op_type}")
+    return ParallelTensorShape(tuple(dims), shape.dtype)
+
+
+_INFO_PARAMS = {
+    OT.OP_REPARTITION: lambda i: RepartitionParams(i.dim, i.degree),
+    OT.OP_COMBINE: lambda i: CombineParams(i.dim, i.degree),
+    OT.OP_REPLICATE: lambda i: ReplicateParams(i.degree),
+    OT.OP_REDUCTION: lambda i: ReductionParams(i.degree),
+}
+
+
+def _identity_infer(params, in_shapes):
+    return [in_shapes[0]]
+
+
+def _identity_forward(params, inputs, weights, state, ctx):
+    # Runtime body is the identity: the executor's sharding constraint on the
+    # node's output performs the actual resharding (ICI collective).
+    return [inputs[0]], state
+
+
+def _zero_flops(params, in_shapes, out_shapes):
+    return 0.0
+
+
+for _ot in (
+    OT.OP_REPARTITION,
+    OT.OP_COMBINE,
+    OT.OP_REPLICATE,
+    OT.OP_REDUCTION,
+    OT.OP_PIPELINE,
+    OT.OP_FUSED_PARALLEL,
+):
+    register_op(
+        OpDef(_ot, _identity_infer, _identity_forward, flops=_zero_flops)
+    )
+
+
+def derive_parallel_assignment(op_type: OT, params, in_assignment, mesh):
+    """Mesh-axis assignment for an explicit parallel-op node's output, derived
+    from its input's assignment (the runtime half of the op: the executor pins
+    the output with this spec, producing the resharding collective).
+
+    Repartition picks the first mesh axis whose size equals the requested
+    degree and which the tensor doesn't already use — the analog of the
+    mapper choosing fresh devices for a higher-degree machine view."""
+    a = [list(x) for x in in_assignment]
+    if op_type == OT.OP_REPARTITION:
+        used = {ax for entry in a for ax in entry}
+        for name, size in mesh.shape.items():
+            if size == params.degree and name not in used:
+                a[params.dim].append(name)
+                break
+        else:
+            raise ValueError(
+                f"repartition(degree={params.degree}): no unused mesh axis "
+                f"of that size in {dict(mesh.shape)}"
+            )
+    elif op_type == OT.OP_COMBINE:
+        removed = 1
+        while removed < params.degree and a[params.dim]:
+            removed *= mesh.shape[a[params.dim].pop()]
+        if removed != params.degree:
+            raise ValueError(
+                f"combine(degree={params.degree}) cannot unshard assignment "
+                f"{in_assignment[params.dim]} over {dict(mesh.shape)}"
+            )
+    elif op_type == OT.OP_FUSED_PARALLEL:
+        cur = tuple(tuple(x) for x in a)
+        for info in params.ops:
+            sub = _INFO_PARAMS.get(info.op_type)
+            if sub is not None:
+                cur = derive_parallel_assignment(
+                    info.op_type, sub(info), cur, mesh
+                )
+        return cur
+    # Replicate / Reduction / Pipeline: replication and partial-sum state are
+    # implicit under GSPMD; the assignment passes through unchanged.
+    return tuple(tuple(x) for x in a)

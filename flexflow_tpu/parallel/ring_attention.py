@@ -1,0 +1,116 @@
+"""Ring attention: sequence/context parallelism over the ICI ring.
+
+The reference has **no long-context support** (SURVEY §5: "no ring attention,
+no Ulysses"); its only sequence notion is a seq_length iteration config. This
+module provides the TPU-native capability the reference lacks: queries stay
+resident on their sequence shard while K/V blocks rotate around the `seq`
+mesh axis via `jax.lax.ppermute`, overlapping each hop with the local
+block-attention compute. Combined across steps with the same online-softmax
+(running max / denominator) used by flash attention, the result is exact
+attention over the full sequence with per-chip memory O(s_local · d) and
+communication that rides neighbor-to-neighbor ICI links only.
+
+Used by MultiHeadAttention(impl="ring") together with the
+`sequence_parallel_attention` strategy (seq dim sharded over AXIS_SEQ).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..machine import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
+
+shard_map = jax.shard_map
+
+
+def _ring_local(q, k, v, *, axis_name: str, n: int, causal: bool,
+                scale: float):
+    """Per-shard body (inside shard_map). q,k,v: (b, h, s_local, d) local.
+
+    Unrolled over the `n` ring steps (n = seq-axis size, small and static) so
+    XLA can overlap each collective-permute with the previous block's
+    compute, and the final rotation — whose result would be discarded — is
+    skipped entirely."""
+    idx = jax.lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    qf = q.astype(jnp.float32)
+
+    m = jnp.full((b, h, s_loc), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, s_loc), jnp.float32)
+    o = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_blk, v_blk = k, v
+
+    for step in range(n):
+        # the block we hold at `step` originated on shard (idx - step) mod n
+        src = jax.lax.rem(idx - step + n, n)
+        logits = jnp.einsum(
+            "bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32)
+        ) * scale
+        if causal:
+            q_pos = idx * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 0
+            )
+            k_pos = src * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 1
+            )
+            mask = q_pos >= k_pos  # (s_loc, s_loc) with global offsets
+            logits = jnp.where(mask[None, None], logits, -1e30)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        # guard fully-masked steps: keep contributions zero until live
+        p = jnp.exp(logits - m_new[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        alpha = jnp.where(
+            jnp.isfinite(m), jnp.exp(m - m_new), jnp.zeros_like(m)
+        )
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        m = m_new
+        if step < n - 1:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q, k, v, *, causal: bool = False, scale: float | None = None,
+    mesh: Mesh | None = None, axis_name: str = AXIS_SEQ,
+    batch_axis: str = AXIS_DATA, head_axis: str = AXIS_MODEL,
+):
+    """Exact attention with the seq dim sharded over `axis_name`.
+
+    q,k,v: (batch, heads, seq, head_dim) global arrays (call under jit).
+    Falls back to single-shard attention when no mesh / seq axis size 1."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if mesh is None or mesh.shape.get(axis_name, 1) == 1:
+        from ..ops.attention import sdpa_xla
+
+        return sdpa_xla(q, k, v, causal=causal, scale=scale)
+
+    spec = P(
+        batch_axis if mesh.shape.get(batch_axis, 1) > 1 else None,
+        head_axis if mesh.shape.get(head_axis, 1) > 1 else None,
+        axis_name,
+        None,
+    )
+    fn = shard_map(
+        functools.partial(
+            _ring_local, axis_name=axis_name, n=mesh.shape[axis_name],
+            causal=causal, scale=scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
