@@ -1,0 +1,110 @@
+"""Benchmark: flagship Transformer LM training throughput on one chip.
+
+Mirrors the reference's benchmark harness (examples/cpp/Transformer/
+transformer.cc:183-211: timed training loop printing ELAPSED TIME /
+THROUGHPUT) with the reference model scale (hidden 1024, 16 heads, 12
+layers, seq 512 — TransformerConfig, transformer.cc:79-85) recast as the
+decoder-only LM, and adds the MFU accounting BASELINE.md targets.
+
+Prints ONE JSON line:
+  {"metric": "transformer_lm_tokens_per_sec_per_chip", "value": N,
+   "unit": "tokens/s", "vs_baseline": MFU / 0.35}
+(vs_baseline = fraction of the 35%-MFU north-star target, BASELINE.json.)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12  # bf16
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v3" in kind:
+        return 123e12
+    if "v6" in kind:
+        return 918e12
+    return 2e12  # CPU fallback so the harness still runs
+
+
+def main():
+    sys.argv = [sys.argv[0]]
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import (
+        TransformerLMConfig,
+        build_transformer_lm,
+    )
+    from flexflow_tpu.models.transformer import transformer_lm_flops_per_token
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = TransformerLMConfig(
+            vocab_size=32000, hidden_size=1024, num_heads=16, num_layers=12,
+            sequence_length=512, attention_impl="flash",
+        )
+        batch = 8
+        steps, warmup = 20, 3
+    else:  # CPU smoke mode
+        cfg = TransformerLMConfig(
+            vocab_size=512, hidden_size=128, num_heads=4, num_layers=2,
+            sequence_length=128, attention_impl="xla",
+        )
+        batch = 4
+        steps, warmup = 5, 1
+
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    build_transformer_lm(ff, cfg, batch_size=batch)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    step_fn = ff.executor.build_train_step()
+
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, cfg.vocab_size,
+                      (batch, cfg.sequence_length)).astype(np.int32)
+    pos = np.tile(np.arange(cfg.sequence_length, dtype=np.int32), (batch, 1))
+    labels = rs.randint(0, cfg.vocab_size,
+                        (batch, cfg.sequence_length, 1)).astype(np.int32)
+    batch_data = ff._make_batch({"tokens": toks, "positions": pos}, labels)
+
+    state = (ff._params, ff._state, ff._opt_slots, ff._step, ff._counters)
+    rng = jax.random.key(0)
+
+    def run(n):
+        nonlocal state, rng
+        for _ in range(n):
+            rng, sub = jax.random.split(rng)
+            p, s, o, st, c, _ = step_fn(*state, sub, batch_data)
+            state = (p, s, o, st, c)
+        jax.block_until_ready(state[0])
+
+    run(warmup)
+    t0 = time.perf_counter()
+    run(steps)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * batch * cfg.sequence_length / dt
+    mfu = tokens_per_sec * transformer_lm_flops_per_token(cfg) / _peak_flops(dev)
+    print(json.dumps({
+        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.35, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -237,8 +237,31 @@ def _spec_nontrivial(spec: PartitionSpec) -> bool:
     return any(entry is not None for entry in spec)
 
 
+_DONATION_OK: Optional[bool] = None
+
+
+def _donation_supported() -> bool:
+    """Probe whether the backend honors donated buffers. XLA:CPU (the
+    virtual-mesh test backend) deadlocks in-process collectives on donated
+    aliases; tunneled TPU backends (axon) reject donation with
+    INVALID_ARGUMENT while presenting themselves as plain 'tpu' — so probe
+    once with a tiny donated jit instead of trusting the platform name."""
+    global _DONATION_OK
+    if _DONATION_OK is None:
+        if jax.default_backend() == "cpu":
+            _DONATION_OK = False
+        else:
+            try:
+                f = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+                out = f(jnp.zeros((8,), jnp.float32))
+                jax.block_until_ready(out)
+                np.asarray(out)
+                _DONATION_OK = True
+            except Exception:
+                _DONATION_OK = False
+    return _DONATION_OK
+
+
 def _donate_argnums(nums: tuple[int, ...]) -> tuple[int, ...]:
-    """Buffer donation saves HBM on TPU; on XLA:CPU (the virtual-mesh test
-    backend) donated buffers aliased into in-process collectives can deadlock
-    the rendezvous, so donation is disabled there."""
-    return nums if jax.default_backend() != "cpu" else ()
+    """Buffer donation saves HBM on TPU when the backend supports it."""
+    return nums if _donation_supported() else ()

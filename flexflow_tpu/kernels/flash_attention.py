@@ -43,6 +43,7 @@ def _attn_reference(q, k, v, causal: bool, scale: float):
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int, seq_k: int,
+    causal_offset: int,
 ):
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -56,8 +57,12 @@ def _flash_kernel(
 
     # with causal masking, kv blocks strictly above the diagonal contribute
     # nothing — skip them entirely (halves the work, like the reference's
-    # unmasked cuDNN op cannot)
-    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    # unmasked cuDNN op cannot). Diagonal is bottom-right aligned
+    # (offset = seq_k - seq_q), matching sdpa_xla's tril(k=s_k-s_q).
+    live = (
+        (j * block_k <= i * block_q + block_q - 1 + causal_offset)
+        if causal else True
+    )
 
     @pl.when(live)
     def _step():
@@ -77,7 +82,7 @@ def _flash_kernel(
             q_pos = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             ) + i * block_q
-            mask = mask & (q_pos >= k_pos)
+            mask = mask & (q_pos + causal_offset >= k_pos)
         logits = jnp.where(mask, logits, NEG_INF)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, logits.max(axis=-1))
@@ -114,7 +119,7 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
     grid = (b * h, pl.cdiv(s_q, bq), pl.cdiv(s_k, bk))
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        seq_k=s_k,
+        seq_k=s_k, causal_offset=s_k - s_q,
     )
     out = pl.pallas_call(
         kernel,

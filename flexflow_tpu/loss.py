@@ -28,12 +28,17 @@ def loss_value(loss_type: LossType, logits, labels, last_op_is_softmax: bool):
     lt = LossType(loss_type)
     b = logits.shape[0]
     if lt == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
-        labels = labels.reshape(b, -1)[:, 0].astype(jnp.int32)
+        # every leading position is a sample (LM case: (b, s, vocab) logits
+        # with (b, s, 1) labels), matching the reference kernel's per-sample
+        # flattening (loss_functions.cu sparse_categorical_crossentropy)
+        num_classes = logits.shape[-1]
+        logp2 = logits.reshape(-1, num_classes)
+        lab = labels.reshape(-1).astype(jnp.int32)
         if last_op_is_softmax:
-            logp = jnp.log(logits + _EPS)
+            logp2 = jnp.log(logp2 + _EPS)
         else:
-            logp = jax.nn.log_softmax(logits, axis=-1)
-        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+            logp2 = jax.nn.log_softmax(logp2, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp2, lab[:, None], axis=-1))
     if lt == LossType.LOSS_CATEGORICAL_CROSSENTROPY:
         logp = jnp.log(logits + _EPS) if last_op_is_softmax else jax.nn.log_softmax(logits, -1)
         return -jnp.sum(labels * logp) / b

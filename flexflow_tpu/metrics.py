@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import math
+
 import jax.numpy as jnp
 
 from .fftype import LossType, MetricsType
@@ -48,32 +50,45 @@ class Metrics:
         return m
 
     def zero_counters(self):
-        z = jnp.zeros((), jnp.float32)
+        # distinct buffers per counter: sharing one zeros() array across all
+        # keys makes buffer donation alias the same buffer 7 times, which
+        # XLA rejects (INVALID_ARGUMENT)
         return {
-            "train_all": z,
-            "train_correct": z,
-            "cce_loss": z,
-            "sparse_cce_loss": z,
-            "mse_loss": z,
-            "rmse_loss": z,
-            "mae_loss": z,
+            k: jnp.zeros((), jnp.float32)
+            for k in (
+                "train_all", "train_correct", "cce_loss", "sparse_cce_loss",
+                "mse_loss", "rmse_loss", "mae_loss",
+            )
         }
 
     def compute(self, counters, logits, labels):
-        """One batch's contribution (metrics_functions.cu update kernels)."""
-        b = logits.shape[0]
+        """One batch's contribution (metrics_functions.cu update kernels).
+
+        Classification metrics treat every leading position as a sample —
+        (b, classes) classifiers and (b, s, vocab) LMs both work (matching
+        loss.py's sparse-CE flattening); sample count follows suit."""
+        classification = (
+            self.measure_accuracy
+            or self.measure_sparse_categorical_crossentropy
+            or self.measure_categorical_crossentropy
+        )
+        if classification:
+            n = math.prod(logits.shape[:-1])
+            flat = logits.reshape(n, logits.shape[-1])
+        else:
+            n = logits.shape[0]
         new = dict(counters)
-        new["train_all"] = counters["train_all"] + b
+        new["train_all"] = counters["train_all"] + n
         eps = 1e-8
         if self.measure_accuracy or self.measure_sparse_categorical_crossentropy:
-            sparse = labels.reshape(b, -1)[:, 0].astype(jnp.int32)
+            sparse = labels.reshape(-1).astype(jnp.int32)
         if self.measure_accuracy:
-            pred = jnp.argmax(logits.reshape(b, -1), axis=-1).astype(jnp.int32)
+            pred = jnp.argmax(flat, axis=-1).astype(jnp.int32)
             new["train_correct"] = counters["train_correct"] + jnp.sum(
                 (pred == sparse).astype(jnp.float32)
             )
         if self.measure_sparse_categorical_crossentropy:
-            logp = jnp.log(logits.reshape(b, -1) + eps)
+            logp = jnp.log(flat + eps)
             new["sparse_cce_loss"] = counters["sparse_cce_loss"] - jnp.sum(
                 jnp.take_along_axis(logp, sparse[:, None], axis=-1)
             )

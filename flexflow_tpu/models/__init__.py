@@ -1,0 +1,20 @@
+"""Model zoo: builder functions reproducing every reference example family
+(SURVEY §2.6: AlexNet, ResNet-50, resnext-50, InceptionV3, Transformer/BERT,
+DLRM, XDL, candle_uno, MLP_Unify, MNIST MLP, MoE) on the FFModel API, plus
+the TPU-native flagship Transformer LM used by bench.py.
+"""
+
+from .alexnet import build_alexnet
+from .candle_uno import build_candle_uno
+from .dlrm import DLRMConfig, build_dlrm
+from .inception import build_inception_v3
+from .mlp import build_mlp_unify, build_mnist_mlp
+from .moe import MoeConfig, build_moe
+from .resnet import build_resnet50, build_resnext50
+from .transformer import (
+    TransformerConfig,
+    TransformerLMConfig,
+    build_transformer,
+    build_transformer_lm,
+)
+from .xdl import build_xdl

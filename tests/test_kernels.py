@@ -80,3 +80,23 @@ def test_flash_attention_ragged_seq():
                               block_q=128, block_k=128)
         np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_cross_causal_alignment():
+    """s_q != s_k causal: mask must be bottom-right aligned like sdpa_xla."""
+    from flexflow_tpu.kernels.flash_attention import (
+        _attn_reference,
+        flash_attention,
+    )
+
+    rs = np.random.RandomState(3)
+    b, h, d = 1, 2, 16
+    q = jnp.asarray(rs.randn(b, h, 128, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, h, 256, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, h, 256, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    expected = _attn_reference(q, k, v, True, scale)
+    got = flash_attention(q, k, v, causal=True, scale=scale,
+                          block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)

@@ -1,0 +1,228 @@
+"""Model zoo tests: every reference example family builds, shape-infers, and
+(for the light ones) trains a step on the virtual mesh (SURVEY §2.6)."""
+
+import sys
+
+import numpy as np
+import pytest
+
+
+def _ff(mesh=(1, 1, 1, 1), batch=8):
+    sys.argv = ["test", "-b", str(batch)]
+    from flexflow_tpu import FFConfig, FFModel
+
+    config = FFConfig()
+    config.mesh_axis_sizes = mesh
+    config.batch_size = batch
+    return FFModel(config)
+
+
+def test_transformer_reference_builds():
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+
+    ff = _ff(batch=4)
+    c = TransformerConfig(hidden_size=64, num_heads=4, num_layers=2,
+                          sequence_length=16)
+    inp, out = build_transformer(ff, c, batch_size=4)
+    assert out.dims == (4, 16, 1)
+    assert len(ff.layers) == 2 * 3 + 1
+
+
+def test_transformer_trains():
+    from flexflow_tpu import LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+
+    ff = _ff(batch=4)
+    c = TransformerConfig(hidden_size=32, num_heads=2, num_layers=1,
+                          sequence_length=8)
+    inp, out = build_transformer(ff, c, batch_size=4)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 8, 32).astype(np.float32)
+    y = rs.randn(8, 8, 1).astype(np.float32)
+    ff.fit(x, y, epochs=1, batch_size=4)
+
+
+def test_transformer_lm_trains():
+    from flexflow_tpu import LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerLMConfig, build_transformer_lm
+
+    ff = _ff(batch=2)
+    c = TransformerLMConfig(vocab_size=64, hidden_size=32, num_heads=2,
+                            num_layers=1, sequence_length=16,
+                            attention_impl="xla")
+    tokens, logits = build_transformer_lm(ff, c, batch_size=2)
+    assert logits.dims == (2, 16, 64)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, 64, (4, 16)).astype(np.int32)
+    pos = np.tile(np.arange(16, dtype=np.int32), (4, 1))
+    labels = rs.randint(0, 64, (4, 16, 1)).astype(np.int32)
+    ff.fit({"tokens": toks, "positions": pos}, labels, epochs=1, batch_size=2)
+
+
+def test_mnist_mlp_builds():
+    from flexflow_tpu.models import build_mnist_mlp
+
+    ff = _ff(batch=8)
+    inp, out = build_mnist_mlp(ff)
+    assert out.dims == (8, 10)
+
+
+def test_mlp_unify_trains():
+    from flexflow_tpu import LossType, SGDOptimizer
+    from flexflow_tpu.models import build_mlp_unify
+
+    ff = _ff(batch=4)
+    inputs, out = build_mlp_unify(ff, batch_size=4, in_dim=16,
+                                  hidden_dims=(32, 10))
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rs = np.random.RandomState(0)
+    x = {"input1": rs.randn(8, 16).astype(np.float32),
+         "input2": rs.randn(8, 16).astype(np.float32)}
+    y = rs.randint(0, 10, (8, 1)).astype(np.int32)
+    ff.fit(x, y, epochs=1, batch_size=4)
+
+
+def test_alexnet_builds():
+    from flexflow_tpu.models import build_alexnet
+
+    ff = _ff(batch=2)
+    inp, out = build_alexnet(ff, batch_size=2)
+    assert out.dims == (2, 10)
+
+
+def test_resnet50_builds():
+    from flexflow_tpu.models import build_resnet50
+
+    ff = _ff(batch=2)
+    inp, out = build_resnet50(ff, batch_size=2)
+    assert out.dims == (2, 10)
+    # 50 convolutional layers + fc (projections excluded): count conv ops
+    from flexflow_tpu.fftype import OperatorType as OT
+
+    convs = [l for l in ff.layers if l.op_type == OT.OP_CONV2D]
+    assert len(convs) == 1 + 16 * 3 + 4  # stem + 16 bottlenecks + 4 proj
+
+
+def test_resnext50_builds():
+    from flexflow_tpu.models import build_resnext50
+
+    ff = _ff(batch=2)
+    inp, out = build_resnext50(ff, batch_size=2)
+    assert out.dims == (2, 10)
+
+
+def test_inception_builds():
+    from flexflow_tpu.models import build_inception_v3
+
+    ff = _ff(batch=2)
+    inp, out = build_inception_v3(ff, batch_size=2)
+    assert out.dims == (2, 10)
+
+
+def test_dlrm_trains():
+    from flexflow_tpu import LossType, SGDOptimizer
+    from flexflow_tpu.models import DLRMConfig, build_dlrm
+
+    ff = _ff(batch=4)
+    c = DLRMConfig(sparse_feature_size=8, embedding_size=(50, 60),
+                   mlp_bot=(4, 8, 8), mlp_top=(24, 16, 2))
+    inputs, out = build_dlrm(ff, c, batch_size=4)
+    assert out.dims == (4, 2)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+    rs = np.random.RandomState(0)
+    x = {
+        "sparse0": rs.randint(0, 50, (8, 1)).astype(np.int64),
+        "sparse1": rs.randint(0, 60, (8, 1)).astype(np.int64),
+        "dense_input": rs.randn(8, 4).astype(np.float32),
+    }
+    y = rs.randn(8, 2).astype(np.float32)
+    ff.fit(x, y, epochs=1, batch_size=4)
+
+
+def test_xdl_builds():
+    from flexflow_tpu.models import build_xdl
+    from flexflow_tpu.models.xdl import XDLConfig
+
+    ff = _ff(batch=4)
+    c = XDLConfig(sparse_feature_size=8, embedding_size=(50, 60),
+                  mlp_top=(16, 2))
+    inputs, out = build_xdl(ff, c, batch_size=4)
+    assert out.dims == (4, 2)
+
+
+def test_candle_uno_trains():
+    from flexflow_tpu import LossType, SGDOptimizer
+    from flexflow_tpu.models import build_candle_uno
+    from flexflow_tpu.models.candle_uno import CandleUnoConfig
+
+    ff = _ff(batch=4)
+    c = CandleUnoConfig(
+        dense_layers=(16, 16), dense_feature_layers=(16, 16),
+        feature_shapes={"dose": 1, "cell.rnaseq": 30,
+                        "drug.descriptors": 40, "drug.fingerprints": 20},
+    )
+    inputs, out = build_candle_uno(ff, c, batch_size=4)
+    assert out.dims == (4, 1)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+    rs = np.random.RandomState(0)
+    x = {t.name: rs.randn(8, t.dims[1]).astype(np.float32)
+         for t in inputs}
+    y = rs.randn(8, 1).astype(np.float32)
+    ff.fit(x, y, epochs=1, batch_size=4)
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_moe_trains(fused):
+    from flexflow_tpu import LossType, SGDOptimizer
+    from flexflow_tpu.models import MoeConfig, build_moe
+
+    ff = _ff(batch=8)
+    c = MoeConfig(num_exp=4, num_select=2, in_dim=16, num_classes=10)
+    inp, out = build_moe(ff, c, batch_size=8, fused=fused)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 16).astype(np.float32)
+    y = rs.randint(0, 10, (16, 1)).astype(np.int32)
+    ff.fit(x, y, epochs=1, batch_size=8)
+
+
+def test_moe_encoder_builds():
+    from flexflow_tpu.models import MoeConfig
+    from flexflow_tpu.models.moe import build_moe_encoder
+
+    ff = _ff(batch=2)
+    c = MoeConfig(num_exp=4, num_select=2, hidden_size=16,
+                  num_attention_heads=2, num_encoder_layers=1)
+    inp, out = build_moe_encoder(ff, c, batch_size=2, seq_length=8)
+    assert out.dims == (2, 10)
+
+
+def test_lm_metrics_sane():
+    """Accuracy/sparse-CCE must count every token position for LM-shaped
+    logits (b, s, vocab)."""
+    import jax.numpy as jnp
+    from flexflow_tpu.fftype import LossType, MetricsType
+    from flexflow_tpu.metrics import Metrics, PerfMetrics
+
+    m = Metrics.from_list(
+        LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        [MetricsType.METRICS_ACCURACY],
+    )
+    b, s, v = 2, 4, 8
+    labels = np.arange(b * s).reshape(b, s, 1) % v
+    logits = np.full((b, s, v), 0.01, np.float32)
+    for i in range(b):
+        for j in range(s):
+            logits[i, j, labels[i, j, 0]] = 1.0  # all predictions correct
+    c = m.compute(m.zero_counters(), jnp.asarray(logits), jnp.asarray(labels))
+    pm = PerfMetrics({k: np.asarray(val) for k, val in c.items()}, m)
+    assert pm.train_all == b * s
+    assert pm.get_accuracy() == 1.0
