@@ -1,0 +1,60 @@
+"""Transformer benchmark example (reference examples/cpp/Transformer/
+transformer.cc). Same CLI flags: --num-layers, --hidden-size, --num-heads,
+--sequence-length; prints ELAPSED TIME / THROUGHPUT like transformer.cc:208.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def parse_tf_args(argv):
+    from flexflow_tpu.models import TransformerConfig
+
+    c = TransformerConfig()
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--num-layers":
+            i += 1; c.num_layers = int(argv[i])
+        elif a == "--hidden-size":
+            i += 1; c.hidden_size = int(argv[i])
+        elif a == "--num-heads":
+            i += 1; c.num_heads = int(argv[i])
+        elif a == "--sequence-length":
+            i += 1; c.sequence_length = int(argv[i])
+        elif a == "--embedding-size":
+            i += 1; c.embedding_size = int(argv[i])
+        i += 1
+    return c
+
+
+def main():
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import build_transformer
+
+    tf_config = parse_tf_args(sys.argv[1:])
+    config = FFConfig()
+    ff = FFModel(config)
+    build_transformer(ff, tf_config, batch_size=config.batch_size)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+
+    rs = np.random.RandomState(0)
+    num_samples = config.batch_size * 4
+    x = rs.randn(num_samples, tf_config.sequence_length,
+                 tf_config.hidden_size).astype(np.float32)
+    y = rs.randn(num_samples, tf_config.sequence_length, 1).astype(np.float32)
+    ff.fit(x, y, epochs=1)  # warmup
+    t0 = time.time()
+    ff.fit(x, y, epochs=config.epochs)
+    dt = time.time() - t0
+    thru = config.epochs * num_samples / dt
+    print(f"ELAPSED TIME = {dt:.4f}s, THROUGHPUT = {thru:.2f} samples/s")
+
+
+if __name__ == "__main__":
+    main()

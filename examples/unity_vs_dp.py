@@ -1,0 +1,66 @@
+"""Unity-searched strategy vs data-parallel-only comparison.
+
+The OSDI'22 AE pattern (reference scripts/osdi22ae/bert.sh: run the same
+model twice, with search and with --only-data-parallel, compare throughput).
+Runs on the virtual CPU mesh by default so it works anywhere:
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/unity_vs_dp.py --mesh 2,4,1,1 --budget 8
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+HIDDEN = 4096
+if "--hidden" in sys.argv:
+    i = sys.argv.index("--hidden")
+    HIDDEN = int(sys.argv[i + 1])
+    del sys.argv[i : i + 2]
+
+
+def run(only_dp: bool):
+    import jax
+
+    if jax.default_backend() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, SGDOptimizer,
+    )
+
+    config = FFConfig()
+    config.only_data_parallel = only_dp
+    if not only_dp and config.search_budget == 0:
+        config.search_budget = 8
+    batch = config.batch_size
+    ff = FFModel(config)
+    x = ff.create_tensor((batch, 512), name="input")
+    t = x
+    for i in range(4):
+        t = ff.dense(t, HIDDEN, ActiMode.AC_MODE_RELU, name=f"fc{i}")
+    t = ff.dense(t, 10, name="head")
+    t = ff.softmax(t, name="sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(batch * 4, 512).astype(np.float32)
+    ys = rs.randint(0, 10, (batch * 4, 1)).astype(np.int32)
+    ff.fit(xs, ys, epochs=1, batch_size=batch)  # warmup + compile
+    t0 = time.time()
+    ff.fit(xs, ys, epochs=2, batch_size=batch)
+    dt = time.time() - t0
+    thru = 2 * 4 * batch / dt
+    return thru
+
+
+if __name__ == "__main__":
+    dp = run(only_dp=True)
+    unity = run(only_dp=False)
+    print(f"DP-only:  {dp:.1f} samples/s")
+    print(f"Unity:    {unity:.1f} samples/s")
+    print(f"speedup:  {unity / dp:.2f}x")

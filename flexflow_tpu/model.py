@@ -50,7 +50,7 @@ from .fftype import (
 from .initializer import Initializer
 from .layer import Layer
 from .loss import loss_value
-from .machine import AXIS_DATA, MachineView, build_mesh
+from .machine import AXIS_DATA, AXIS_MODEL, MachineView, build_mesh
 from .metrics import Metrics, PerfMetrics
 from .optimizer import Optimizer, SGDOptimizer
 from .ops import (
@@ -610,6 +610,22 @@ class FFModel:
 
         # --- mesh + strategy
         self.mesh = build_mesh(self.config.mesh_shape())
+        if (
+            self._strategy is None
+            and not self.config.only_data_parallel
+            and self.mesh.shape.get(AXIS_MODEL, 1) > 1
+            and (
+                self.config.search_budget > 0
+                or self.config.enable_parameter_parallel
+                or self.config.enable_attribute_parallel
+            )
+        ):
+            # GRAPH_OPTIMIZE_TASK analog: Unity search over the PCG
+            from .search import search_strategy
+
+            self._strategy = search_strategy(
+                g, self.mesh, self.config
+            ).overrides
         self._assign_strategy()
 
         # --- logits node = last layer's op

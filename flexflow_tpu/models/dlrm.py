@@ -25,12 +25,15 @@ class DLRMConfig:
 
 
 def _create_mlp(ff, input, dims, sigmoid_layer, prefix):
-    """dlrm.cc:44-65: dense chain, relu except sigmoid at `sigmoid_layer`."""
+    """dlrm.cc:44-65 (xdl.cc:38-59 identical): dims[0] is the input width;
+    emit len-1 bias-free dense layers, relu except sigmoid at
+    `sigmoid_layer`."""
     t = input
     for i in range(len(dims) - 1):
         act = (ActiMode.AC_MODE_SIGMOID if i == sigmoid_layer
                else ActiMode.AC_MODE_RELU)
-        t = ff.dense(t, dims[i + 1], act, name=f"{prefix}fc{i}")
+        t = ff.dense(t, dims[i + 1], act, use_bias=False,
+                     name=f"{prefix}fc{i}")
     return t
 
 

@@ -109,10 +109,12 @@ def build_transformer_lm(ff, config: TransformerLMConfig | None = None,
 
 
 def transformer_lm_flops_per_token(c: TransformerLMConfig) -> float:
-    """Analytic fwd+bwd FLOPs/token for MFU accounting (6ND + attention)."""
+    """Analytic fwd+bwd FLOPs/token for MFU accounting (6N_matmul + attn).
+    The wte/wpe lookups are gathers (no matmul FLOPs); only the lm_head's
+    v×d projection counts among the embedding-sized params."""
     d, L, s, v = c.hidden_size, c.num_layers, c.sequence_length, c.vocab_size
     params_per_layer = 4 * d * d + 2 * c.mlp_ratio * d * d
-    n_params = L * params_per_layer + 2 * v * d  # embeddings + head
-    flops = 6.0 * n_params
+    n_matmul_params = L * params_per_layer + v * d  # lm_head only
+    flops = 6.0 * n_matmul_params
     flops += L * 12.0 * d * s / 2  # causal attention scores+values fwd+bwd
     return flops

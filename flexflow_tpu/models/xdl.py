@@ -1,13 +1,16 @@
 """XDL: examples/cpp/XDL/xdl.cc — DLRM-style sparse embeddings concatenated
-straight into a top MLP (no dense bottom tower); mlp_top (256,256,256,2)."""
+straight into a top MLP (no dense bottom tower); mlp_top (256,256,256,2),
+where mlp_top[0] is the concat width and len-1 layers are emitted
+(xdl.cc:43, same create_mlp as DLRM)."""
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 from typing import Sequence
 
-from ..fftype import ActiMode, AggrMode, DataType
+from ..fftype import AggrMode, DataType
 from ..initializer import UniformInitializer
+from .dlrm import _create_mlp
 
 
 @dataclass
@@ -36,9 +39,5 @@ def build_xdl(ff, config: XDLConfig | None = None,
                          name=f"emb{i}")
         ly.append(ff.cast(t, DataType.DT_FLOAT, name=f"emb{i}_cast"))
     z = ff.concat(ly, -1, name="interact")
-    t = z
-    for i, h in enumerate(c.mlp_top):
-        act = (ActiMode.AC_MODE_SIGMOID if i == len(c.mlp_top) - 1
-               else ActiMode.AC_MODE_RELU)
-        t = ff.dense(t, h, act, name=f"top_fc{i}")
+    t = _create_mlp(ff, z, c.mlp_top, len(c.mlp_top) - 2, "top_")
     return tuple(sparse_inputs), t

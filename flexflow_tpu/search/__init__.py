@@ -1,0 +1,22 @@
+"""Unity-style parallelization search (SURVEY §2.1 L4/L4').
+
+The reference jointly optimizes algebraic substitutions + parallelization via
+GraphXfer rewrites, a DP over graph decompositions, and a measured+analytic
+simulator (graph.cc, substitution.cc, simulator.cc). The TPU-native recast:
+
+- the strategy space is per-node mesh-axis assignments (MachineView analog)
+  rather than device lists — XLA/GSPMD executes whatever assignment we pick,
+  so the search's job is purely to pick minimum-makespan assignments;
+- the simulator's measured kernels become an MXU/VPU roofline (optionally
+  calibrated by one-chip microbenchmarks), and its network model becomes an
+  ICI torus model (machine_model.py);
+- GraphXfer parallelization rewrites (partition/replicate/combine families,
+  substitution.cc:1726-1868) become per-node candidate configs; algebraic
+  fusion rewrites are unnecessary (XLA fuses);
+- the DP over sequence splits (SearchHelper::graph_cost) survives as-is, and
+  base_optimize's budget/alpha best-first loop drives config moves.
+"""
+
+from .cost_model import CostMetrics, CostModel, classify_reshard
+from .machine_model import TPUMachineModel, machine_model_for_mesh
+from .unity import UnitySearch, search_strategy

@@ -1,0 +1,231 @@
+"""Cost model: per-op compute cost + inter-op resharding cost.
+
+Reference: Simulator::measure_operator_cost (real kernel timing cached by
+(OperatorParameters, MachineView), simulator.h:691-783) + the task-graph
+makespan simulation with communication edges. TPU recast:
+
+- compute: analytic MXU/HBM roofline on the *per-shard* tensor shapes (the
+  shapes a chip actually sees under the candidate assignment), optionally
+  calibrated by timing jitted ops on the real chip (`calibrate`, the
+  inner_measure_operator_cost analog — model.cu:38-75);
+- communication: classify the (producer spec → consumer spec) transition
+  into the XLA collective GSPMD will insert and price it with the machine
+  model. This is exactly the role of the reference's parallel ops: a
+  Combine node priced as partition copies becomes an all_gather here;
+- weight sync: a weight replicated across `data` with its op's inputs
+  sharded over `data` incurs a gradient all_reduce per step (the NCCL
+  optimizer allreduce, optimizer_kernel.cu:78-110);
+- memory: per-chip bytes of weights + activations under the assignment
+  (MemoryUsage analog, memory_optimization.h:44-105).
+
+CostMetrics mirrors the reference struct (simulator.h:54-88).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from ..fftype import DataType, OperatorType as OT
+from .machine_model import TPUMachineModel
+
+_DTYPE_BYTES = {
+    DataType.DT_FLOAT: 4, DataType.DT_DOUBLE: 8, DataType.DT_HALF: 2,
+    DataType.DT_INT32: 4, DataType.DT_INT64: 8, DataType.DT_BOOLEAN: 1,
+}
+
+
+def dtype_bytes(dt) -> int:
+    return _DTYPE_BYTES.get(dt, 4)
+
+
+@dataclass
+class CostMetrics:
+    """Parity with simulator.h:54-88."""
+
+    forward_time: float = 0.0
+    backward_time: float = 0.0
+    sync_time: float = 0.0       # gradient allreduce
+    comm_time: float = 0.0       # input resharding
+    memory: float = 0.0          # per-chip bytes
+
+    @property
+    def total(self) -> float:
+        return (self.forward_time + self.backward_time + self.sync_time
+                + self.comm_time)
+
+
+def _shard_elems(shape: tuple[int, ...], assignment, axis_sizes) -> float:
+    """Per-chip element count of a tensor under an axis assignment."""
+    n = 1.0
+    for i, dim in enumerate(shape):
+        deg = 1
+        if assignment and i < len(assignment):
+            for ax in assignment[i]:
+                deg *= axis_sizes.get(ax, 1)
+        n *= max(1, math.ceil(dim / deg))
+    return n
+
+
+def _axes_of(assignment) -> set:
+    out = set()
+    for entry in assignment or ():
+        out.update(entry)
+    return out
+
+
+def classify_reshard(shape, from_assign, to_assign, dtype, machine:
+                     TPUMachineModel) -> float:
+    """Price the collective GSPMD inserts for producer spec → consumer spec.
+
+    Per-dim transitions:
+      axis removed from a dim          → all_gather over that axis
+      axis added to a dim              → local slice (free)
+      axis moved between dims          → all_to_all
+    (the Combine / Repartition / FusedParallelOp runtime costs, SURVEY §2.3)
+    """
+    if from_assign == to_assign:
+        return 0.0
+    bytes_el = dtype_bytes(dtype)
+    cost = 0.0
+    ndim = len(shape)
+    from_assign = tuple(from_assign or ((),) * ndim)
+    to_assign = tuple(to_assign or ((),) * ndim)
+    removed, added = [], []
+    for i in range(ndim):
+        f = set(from_assign[i]) if i < len(from_assign) else set()
+        t = set(to_assign[i]) if i < len(to_assign) else set()
+        removed += [(i, ax) for ax in f - t]
+        added += [(i, ax) for ax in t - f]
+    moved = {ax for _, ax in removed} & {ax for _, ax in added}
+    # bytes of the local shard *before* the transition
+    local_bytes = _shard_elems(shape, from_assign, machine.axis_sizes) * bytes_el
+    for _, ax in removed:
+        if ax in moved:
+            cost += machine.all_to_all(local_bytes, ax)
+        else:
+            n = machine.axis_size(ax)
+            cost += machine.all_gather(local_bytes * n, ax)
+    # additions alone are local dynamic-slices: free
+    return cost
+
+
+class CostModel:
+    """Costs one node / one whole strategy; memoized like the reference's
+    (params, view) cache (simulator.h strict/relaxed hash caches)."""
+
+    def __init__(self, machine: TPUMachineModel, mfu: float = 0.4):
+        self.machine = machine
+        # achievable fraction of peak (calibration refines per-op)
+        self.mfu = mfu
+        self._cache: dict = {}
+        self._calibration: dict = {}
+
+    # -------------------------------------------------------------- op cost
+
+    def op_cost(self, node, out_assigns, weight_specs_assigns,
+                in_shapes, in_assigns) -> CostMetrics:
+        key = (node.guid,
+               tuple(tuple(a) for a in out_assigns or ()),
+               tuple(sorted((k, str(v)) for k, v in
+                            (weight_specs_assigns or {}).items())))
+        if key in self._cache:
+            return self._cache[key]
+
+        axis_sizes = self.machine.axis_sizes
+        op_def = node.op_def
+        # shard the op: flops scale by the product of degrees over sharded
+        # dims of the OUTPUT (each chip computes its shard)
+        out_shapes = [tuple(d.size for d in pt.shape.dims
+                            if not d.is_replica_dim) for pt in node.outputs]
+        full_flops = op_def.flops(node.params, list(in_shapes), out_shapes)
+        degree = 1
+        if out_assigns:
+            for ax in _axes_of(out_assigns[0]):
+                degree *= axis_sizes.get(ax, 1)
+        shard_flops = full_flops / max(1, degree)
+
+        # bytes touched: inputs + outputs + weights per chip
+        bytes_touched = 0.0
+        for shape, assign in zip(in_shapes, in_assigns):
+            bytes_touched += _shard_elems(shape, assign, axis_sizes) * 4
+        for i, pt in enumerate(node.outputs):
+            a = out_assigns[i] if out_assigns and i < len(out_assigns) else ()
+            bytes_touched += _shard_elems(
+                tuple(d.size for d in pt.shape.dims if not d.is_replica_dim),
+                a, axis_sizes) * dtype_bytes(pt.dtype)
+
+        weight_bytes = 0.0
+        sync = 0.0
+        for ws in node.weight_specs:
+            spec = (weight_specs_assigns or {}).get(ws.name)
+            w_assign = _spec_to_assignment(spec, len(ws.shape))
+            wb = _shard_elems(ws.shape, w_assign, axis_sizes) * dtype_bytes(ws.dtype)
+            weight_bytes += wb
+            bytes_touched += wb
+            if ws.trainable:
+                # gradient allreduce over every data-ish axis the weight is
+                # NOT sharded over but its consumers' activations are
+                w_axes = _axes_of(w_assign)
+                act_axes = _axes_of(out_assigns[0] if out_assigns else ())
+                for ax in act_axes - w_axes:
+                    sync += self.machine.all_reduce(wb, ax)
+
+        eff_peak_t = self.machine.compute_time(shard_flops / self.mfu,
+                                               bytes_touched)
+        calib = self._calibration.get(_params_key(node))
+        fwd = calib if calib is not None else eff_peak_t
+        # rule of thumb (also the reference simulator's default): bwd ≈ 2× fwd
+        cm = CostMetrics(
+            forward_time=fwd,
+            backward_time=2.0 * fwd,
+            sync_time=sync,
+            memory=weight_bytes * 3  # weight + grad + optimizer slot
+            + _shard_elems(out_shapes[0] if out_shapes else (),
+                           out_assigns[0] if out_assigns else (),
+                           axis_sizes) * 4,
+        )
+        self._cache[key] = cm
+        return cm
+
+    # -------------------------------------------------------- calibration
+
+    def calibrate(self, node, fn, example_args) -> float:
+        """Measure a jitted op on the real chip and pin its cost (the
+        Op::inner_measure_operator_cost analog: warmup + timed repeats,
+        model.cu:38-75)."""
+        import time
+
+        import jax
+
+        jf = jax.jit(fn)
+        out = jf(*example_args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            out = jf(*example_args)
+        jax.block_until_ready(out)
+        t = (time.perf_counter() - t0) / reps
+        self._calibration[_params_key(node)] = t
+        return t
+
+
+def _params_key(node):
+    return (node.op_type, repr(node.params))
+
+
+def _spec_to_assignment(spec, ndim):
+    """PartitionSpec (or None) → per-dim axis tuples."""
+    if spec is None:
+        return ((),) * ndim
+    entries = []
+    for i in range(ndim):
+        e = spec[i] if i < len(spec) else None
+        if e is None:
+            entries.append(())
+        elif isinstance(e, (tuple, list)):
+            entries.append(tuple(e))
+        else:
+            entries.append((e,))
+    return tuple(entries)

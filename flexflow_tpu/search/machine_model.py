@@ -1,0 +1,145 @@
+"""Analytic TPU machine model: compute roofline + ICI/DCN collectives.
+
+Reference: the MachineModel hierarchy (include/flexflow/simulator.h:212-615 —
+SimpleMachineModel's intra/inter bandwidths, EnhancedMachineModel's per-path
+congestion, NetworkedMachineModel's topology routing). On TPU the network is
+a wraparound torus of uniform ICI links per chip, so the analytic model is
+simpler and *more* accurate than the reference's NIC/NVLink approximations:
+bandwidth-optimal collectives on a ring/torus have closed-form costs.
+
+Collective costs over an axis of size n with per-chip payload B bytes on a
+ring (all links active, bidirectional):
+  all_gather / reduce_scatter:  (n-1)/n · B_full / bw      (B_full = n·B out)
+  all_reduce:                   2·(n-1)/n · B / bw
+  all_to_all:                   (n-1)/n · B / bw           (B = per-chip send)
+  ppermute (ring hop):          B / bw
+Latency: per-hop α added once per step ((n-1) steps).
+
+Chip specs default to the device JAX reports; numbers are public datasheet
+values (bf16 peak, HBM BW, ICI per-link).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    peak_flops: float      # bf16 FLOP/s
+    hbm_bandwidth: float   # B/s
+    hbm_bytes: float       # device memory capacity
+    ici_bandwidth: float   # B/s per link direction
+    ici_links: int         # torus links per chip
+    ici_latency: float = 1e-6
+    dcn_bandwidth: float = 25e9 / 8  # per-host, conservative
+    dcn_latency: float = 10e-6
+
+
+CHIPS = {
+    "v5e": ChipSpec("v5e", 197e12, 8.1e11, 16e9, 4.5e10, 4),
+    "v5p": ChipSpec("v5p", 459e12, 2.765e12, 95e9, 9e10, 6),
+    "v4": ChipSpec("v4", 275e12, 1.2e12, 32e9, 4.5e10, 6),
+    "v6e": ChipSpec("v6e", 918e12, 1.64e12, 32e9, 9e10, 4),
+    "cpu": ChipSpec("cpu", 2e11, 5e10, 32e9, 1e10, 2),
+}
+
+
+def detect_chip() -> ChipSpec:
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        kind = getattr(dev, "device_kind", "").lower()
+        if "v5 lite" in kind or "v5e" in kind:
+            return CHIPS["v5e"]
+        if "v5" in kind:
+            return CHIPS["v5p"]
+        if "v4" in kind:
+            return CHIPS["v4"]
+        if "v6" in kind:
+            return CHIPS["v6e"]
+        if dev.platform == "cpu":
+            return CHIPS["cpu"]
+    except Exception:
+        pass
+    return CHIPS["v5p"]
+
+
+@dataclass
+class TPUMachineModel:
+    """Collective cost oracle over the mesh's named axes.
+
+    `axis_links[axis]` = number of physical torus links serving that mesh
+    axis (a mesh axis folded over 2 torus dims gets 2× bandwidth); axes that
+    span hosts use DCN instead (axis_over_dcn)."""
+
+    chip: ChipSpec
+    axis_sizes: dict  # axis name -> size
+    axis_links: dict | None = None
+    axis_over_dcn: frozenset = frozenset()
+
+    def _bw(self, axis: str) -> float:
+        if axis in self.axis_over_dcn:
+            return self.chip.dcn_bandwidth
+        links = (self.axis_links or {}).get(axis, 1)
+        return self.chip.ici_bandwidth * links
+
+    def _lat(self, axis: str) -> float:
+        return (self.chip.dcn_latency if axis in self.axis_over_dcn
+                else self.chip.ici_latency)
+
+    def axis_size(self, axis: str) -> int:
+        return self.axis_sizes.get(axis, 1)
+
+    def all_gather(self, out_bytes: float, axis: str) -> float:
+        n = self.axis_size(axis)
+        if n <= 1:
+            return 0.0
+        return (n - 1) / n * out_bytes / self._bw(axis) + (n - 1) * self._lat(axis)
+
+    def reduce_scatter(self, in_bytes: float, axis: str) -> float:
+        return self.all_gather(in_bytes, axis)
+
+    def all_reduce(self, bytes_per_chip: float, axis: str) -> float:
+        n = self.axis_size(axis)
+        if n <= 1:
+            return 0.0
+        return (2.0 * (n - 1) / n * bytes_per_chip / self._bw(axis)
+                + 2 * (n - 1) * self._lat(axis))
+
+    def all_to_all(self, send_bytes_per_chip: float, axis: str) -> float:
+        n = self.axis_size(axis)
+        if n <= 1:
+            return 0.0
+        return ((n - 1) / n * send_bytes_per_chip / self._bw(axis)
+                + (n - 1) * self._lat(axis))
+
+    def ppermute(self, bytes_per_chip: float, axis: str) -> float:
+        return bytes_per_chip / self._bw(axis) + self._lat(axis)
+
+    def compute_time(self, flops: float, bytes_touched: float) -> float:
+        """Roofline: max of MXU time and HBM time (the simulator's measured
+        per-op µs analog; see CostModel.calibrate for the measured path)."""
+        return max(flops / self.chip.peak_flops,
+                   bytes_touched / self.chip.hbm_bandwidth)
+
+
+def machine_model_for_mesh(mesh, chip: ChipSpec | None = None,
+                           num_hosts: int = 1) -> TPUMachineModel:
+    chip = chip or detect_chip()
+    axis_sizes = dict(mesh.shape) if hasattr(mesh, "shape") else dict(mesh)
+    # heuristic: the largest axis gets folded over 2 torus dims when the
+    # chip has >4 links (v5p 3D torus)
+    links = {a: 1 for a in axis_sizes}
+    if chip.ici_links >= 6 and axis_sizes:
+        big = max(axis_sizes, key=lambda a: axis_sizes[a])
+        links[big] = 2
+    over_dcn = frozenset()
+    if num_hosts > 1:
+        # outermost axis spans hosts
+        first = next(iter(axis_sizes)) if axis_sizes else None
+        if first is not None:
+            over_dcn = frozenset({first})
+    return TPUMachineModel(chip, axis_sizes, links, over_dcn)

@@ -1,0 +1,409 @@
+"""Unity search: choose a mesh-axis assignment per PCG node.
+
+Algorithm parity with the reference (SURVEY §3.2):
+
+- `graph_cost` DP over sequence splits at bottleneck nodes
+  (SearchHelper::find_optimal_sequence_graph_time, graph.cc:115-180): a
+  bottleneck is a node every source→sink path crosses; the DP state is the
+  candidate config of the bottleneck tensor, and segment costs are memoized
+  per (in_config, out_config) — exactly the reference's memoized
+  sequence-split recursion with MachineViews replaced by axis assignments.
+- inside a segment, configs are enumerated jointly when the segment is small
+  (the reference's nonsequence exhaustive split, graph.cc:267-321) and
+  greedily otherwise.
+- the candidate configs per node are the reference's parallelization
+  substitution families (substitution.cc:1726-1868): data-parallel,
+  partition-linear-combine (column TP), replicate-linear-reduce (row TP),
+  partition-attention (head TP), expert partition; gated by the same flags
+  (--enable-parameter-parallel etc., config.h:133-137).
+- `base_optimize`-style refinement: best-first over single-segment config
+  changes with a search budget and alpha pruning (substitution.cc:2229-2311).
+- memory-aware search: per-chip memory validity (graph.cc:1983-2032) and the
+  λ runtime/memory blend binary search (graph_optimize_task, 2056-2131).
+
+Output is a `parallel.Strategy` consumed by FFModel.compile.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from jax.sharding import PartitionSpec
+
+from ..fftype import OperatorType as OT
+from ..machine import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
+from ..parallel.strategies import Strategy
+from .cost_model import (
+    CostModel,
+    _axes_of,
+    _shard_elems,
+    _spec_to_assignment,
+    classify_reshard,
+    dtype_bytes,
+)
+from .machine_model import TPUMachineModel
+
+
+@dataclass(frozen=True)
+class NodeConfig:
+    """One parallelization choice for a node (the MachineView analog)."""
+
+    name: str  # dp | tp_col | tp_row | tp_attn | ep | replicated
+    out_assign: tuple        # output axis assignment
+    weight_specs: tuple = () # ((weight_name, PartitionSpec), ...)
+    # extra collective cost this config implies (e.g. row-parallel psum)
+    psum_axes: tuple = ()
+
+
+def _dp_assign(ndim, batch_ok=True, last_axes=()):
+    a = [()] * ndim
+    if ndim > 0 and batch_ok:
+        a[0] = (AXIS_DATA,)
+    if last_axes and ndim > 1:
+        a[-1] = tuple(last_axes)
+    return tuple(a)
+
+
+class UnitySearch:
+    def __init__(self, graph, mesh, config, cost_model: CostModel):
+        self.graph = graph
+        self.mesh = mesh
+        self.config = config
+        self.cm = cost_model
+        self.axis_sizes = dict(mesh.shape)
+        self.model_deg = self.axis_sizes.get(AXIS_MODEL, 1)
+        self.data_deg = self.axis_sizes.get(AXIS_DATA, 1)
+        self.order = graph.topo_order()
+        self._segment_cache: dict = {}
+
+    # ---------------------------------------------------- candidate configs
+
+    def node_configs(self, node) -> list[NodeConfig]:
+        """Candidate parallelizations (substitution families)."""
+        ndim = len(node.outputs[0].shape.dims) if node.outputs else 0
+        batch_ok = (ndim > 0 and node.outputs and
+                    node.outputs[0].shape.dims[0].size % max(1, self.data_deg) == 0
+                    and node.op_type != OT.OP_GROUP_BY)
+        dp = NodeConfig("dp", _dp_assign(ndim, batch_ok))
+        out = [dp]
+        if self.config.only_data_parallel or self.model_deg <= 1:
+            return out
+        allow_param = (self.config.enable_parameter_parallel
+                       or self.config.search_budget > 0)
+        allow_attr = (self.config.enable_attribute_parallel
+                      or self.config.search_budget > 0)
+        if node.op_type == OT.OP_LINEAR and allow_param:
+            p = node.params
+            if p.out_channels % self.model_deg == 0:
+                out.append(NodeConfig(
+                    "tp_col",
+                    _dp_assign(ndim, batch_ok, last_axes=(AXIS_MODEL,)),
+                    (("kernel", PartitionSpec(None, AXIS_MODEL)),
+                     ("bias", PartitionSpec(AXIS_MODEL))),
+                ))
+            out.append(NodeConfig(
+                "tp_row", _dp_assign(ndim, batch_ok),
+                (("kernel", PartitionSpec(AXIS_MODEL, None)),
+                 ("bias", PartitionSpec())),
+                psum_axes=(AXIS_MODEL,),
+            ))
+        elif node.op_type == OT.OP_MULTIHEAD_ATTENTION and allow_attr:
+            p = node.params
+            if p.num_heads % self.model_deg == 0:
+                ws = [(w, PartitionSpec(None, AXIS_MODEL))
+                      for w in ("wq", "wk", "wv")]
+                ws += [(b, PartitionSpec(AXIS_MODEL))
+                       for b in ("bq", "bk", "bv")]
+                ws += [("wo", PartitionSpec(AXIS_MODEL, None)),
+                       ("bo", PartitionSpec())]
+                out.append(NodeConfig(
+                    "tp_attn", _dp_assign(ndim, batch_ok), tuple(ws),
+                    psum_axes=(AXIS_MODEL,),
+                ))
+        elif node.op_type == OT.OP_EXPERTS and allow_attr:
+            p = node.params
+            if p.n % self.model_deg == 0:
+                ws = [("kernel", PartitionSpec(AXIS_MODEL, None, None))]
+                if p.use_bias:
+                    ws.append(("bias", PartitionSpec(AXIS_MODEL, None)))
+                out.append(NodeConfig("ep", _dp_assign(ndim, batch_ok),
+                                      tuple(ws)))
+        elif node.op_type == OT.OP_EMBEDDING and allow_param:
+            p = node.params
+            if p.out_channels % self.model_deg == 0:
+                out.append(NodeConfig(
+                    "tp_col",
+                    _dp_assign(ndim, batch_ok, last_axes=(AXIS_MODEL,)),
+                    (("kernel", PartitionSpec(None, AXIS_MODEL)),),
+                ))
+        elif node.op_type in _FEATURE_ELEMENTWISE and ndim > 1:
+            # pass-through configs so TP activations can stay sharded
+            # across elementwise/norm ops between a col/row pair
+            dims = node.outputs[0].shape.dims
+            if dims[-1].size % self.model_deg == 0:
+                out.append(NodeConfig(
+                    "feat", _dp_assign(ndim, batch_ok,
+                                       last_axes=(AXIS_MODEL,)),
+                ))
+        return out
+
+    # ---------------------------------------------------- strategy evaluation
+
+    def evaluate(self, choice: dict) -> tuple[float, float]:
+        """(makespan seconds, peak per-chip memory bytes) of a full
+        assignment {guid -> NodeConfig} — the simulate_runtime analog."""
+        total = 0.0
+        mem = 0.0
+        for node in self.order:
+            if node.op_type in (OT.OP_INPUT, OT.OP_WEIGHT, OT.OP_NOOP):
+                continue
+            cfg = choice.get(node.guid)
+            if cfg is None:
+                continue
+            in_shapes, in_assigns, reshard = [], [], 0.0
+            for e in sorted(self.graph.in_edges[node.guid],
+                            key=lambda e: e.dst_idx):
+                src = self.graph.nodes[e.src]
+                src_cfg = choice.get(src.guid)
+                src_assign = (src_cfg.out_assign if src_cfg
+                              else _dp_assign(
+                                  len(src.outputs[e.src_idx].shape.dims)))
+                shape = tuple(d.size for d in
+                              src.outputs[e.src_idx].shape.dims
+                              if not d.is_replica_dim)
+                in_shapes.append(shape)
+                in_assigns.append(src_assign)
+                # consumer's expected input spec: tp_row expects the feature
+                # dim sharded (no reshard after tp_col); dp expects batch
+                expected = self._expected_input(node, cfg, e.dst_idx,
+                                                len(shape))
+                if expected is not None:
+                    reshard += classify_reshard(
+                        shape, src_assign, expected,
+                        src.outputs[e.src_idx].dtype, self.cm.machine)
+            cm = self.cm.op_cost(node, [cfg.out_assign] * len(node.outputs),
+                                 dict(cfg.weight_specs), in_shapes,
+                                 in_assigns)
+            psum = 0.0
+            for ax in cfg.psum_axes:
+                out_pt = node.outputs[0]
+                shard_bytes = _shard_elems(
+                    tuple(d.size for d in out_pt.shape.dims
+                          if not d.is_replica_dim),
+                    cfg.out_assign, self.axis_sizes) * dtype_bytes(out_pt.dtype)
+                psum += self.cm.machine.all_reduce(shard_bytes, ax)
+            total += cm.total + reshard + psum
+            mem += cm.memory
+        return total, mem
+
+    def _expected_input(self, node, cfg, dst_idx, ndim):
+        """The input spec a config consumes (None = producer's choice OK)."""
+        if cfg.name == "tp_row" and dst_idx == 0:
+            return _dp_assign(ndim, True, last_axes=(AXIS_MODEL,))
+        if cfg.name in ("dp", "tp_col", "tp_attn", "ep") and dst_idx == 0:
+            return _dp_assign(ndim, True)
+        return None
+
+    # ---------------------------------------------------- bottleneck DP
+
+    def bottlenecks(self) -> list:
+        """Nodes every source→sink path crosses (the sequence-split points,
+        graph.cc find_bottleneck_node)."""
+        order = [n for n in self.order]
+        idx = {n.guid: i for i, n in enumerate(order)}
+        out = []
+        open_edges = 0
+        for i, n in enumerate(order):
+            open_edges -= len(self.graph.in_edges[n.guid])
+            if open_edges == 0 and i < len(order) - 1:
+                out.append(n)
+            open_edges += len(self.graph.out_edges[n.guid])
+        return out
+
+    def run(self) -> dict:
+        """DP over bottleneck segments + best-first refinement. Returns
+        {guid -> NodeConfig}."""
+        segments = self._split_segments()
+        choice: dict = {}
+        for seg in segments:
+            choice.update(self._optimize_segment(seg, choice))
+        choice = self._refine(choice)
+        return choice
+
+    def _split_segments(self):
+        cuts = {n.guid for n in self.bottlenecks()}
+        segments, cur = [], []
+        for n in self.order:
+            cur.append(n)
+            if n.guid in cuts and len(cur) >= self.config.base_optimize_threshold:
+                segments.append(cur)
+                cur = []
+        if cur:
+            segments.append(cur)
+        return segments
+
+    def _optimize_segment(self, seg, context: dict) -> dict:
+        """Jointly enumerate configs for interesting nodes in the segment
+        (the nonsequence exhaustive split); pass-through nodes follow."""
+        interesting = [n for n in seg
+                       if len(self.node_configs(n)) > 1]
+        base = {n.guid: self.node_configs(n)[0] for n in seg}
+        if not interesting:
+            return base
+        # cap the joint enumeration (reference caps via threshold + DP)
+        cap = 6
+        heads, tail = interesting[:cap], interesting[cap:]
+        best, best_cost = base, None
+        for combo in itertools.product(
+                *(self.node_configs(n) for n in heads)):
+            cand = dict(base)
+            for n, cfg in zip(heads, combo):
+                cand[n.guid] = cfg
+            self._propagate_feature_chains(seg, cand)
+            full = dict(context)
+            full.update(cand)
+            cost, mem = self.evaluate(full)
+            cost = self._memory_penalized(cost, mem)
+            if best_cost is None or cost < best_cost:
+                best, best_cost = cand, cost
+        for n in tail:  # greedy for the rest
+            cands = self.node_configs(n)
+            cur_best, cur_cost = None, None
+            for cfg in cands:
+                cand = dict(best)
+                cand[n.guid] = cfg
+                full = dict(context)
+                full.update(cand)
+                cost, mem = self.evaluate(full)
+                cost = self._memory_penalized(cost, mem)
+                if cur_cost is None or cost < cur_cost:
+                    cur_best, cur_cost = cand, cost
+            best = cur_best
+        return best
+
+    def _propagate_feature_chains(self, seg, cand):
+        """Between a tp_col producer and a tp_row consumer, flip elementwise
+        nodes to their 'feat' config so the sharded activation survives."""
+        by_guid = {n.guid: n for n in seg}
+        for n in seg:
+            cfg = cand.get(n.guid)
+            if cfg is None or cfg.name != "tp_row":
+                continue
+            # walk the first-input chain upward while elementwise
+            cur = n
+            while True:
+                edges = self.graph.in_edges[cur.guid]
+                if not edges:
+                    break
+                src = self.graph.nodes[sorted(edges,
+                                              key=lambda e: e.dst_idx)[0].src]
+                if src.guid not in by_guid:
+                    break
+                scfg = cand.get(src.guid)
+                if scfg and scfg.name in ("tp_col", "tp_attn"):
+                    break
+                feats = [c for c in self.node_configs(src)
+                         if c.name == "feat"]
+                if not feats:
+                    break
+                cand[src.guid] = feats[0]
+                cur = src
+
+    def _memory_penalized(self, cost, mem):
+        cap = self.cm.machine.chip.hbm_bytes
+        if mem > cap:
+            # invalid strategy: harsh penalty (is_valid_strategy analog)
+            return cost * (1.0 + 10.0 * (mem - cap) / cap)
+        if self.config.perform_memory_search:
+            lam = getattr(self, "_lambda", 0.0)
+            return cost * (1 - lam) + lam * cost * (mem / cap)
+        return cost
+
+    def _refine(self, choice: dict) -> dict:
+        """Budgeted best-first single-node moves (base_optimize analog)."""
+        budget = self.config.search_budget or 8
+        alpha = self.config.search_alpha
+        best = dict(choice)
+        best_cost, _ = self.evaluate(best)
+        frontier = [best]
+        seen = set()
+        for _ in range(budget):
+            if not frontier:
+                break
+            cur = frontier.pop(0)
+            for node in self.order:
+                for cfg in self.node_configs(node)[1:]:
+                    if cur.get(node.guid) is cfg:
+                        continue
+                    cand = dict(cur)
+                    cand[node.guid] = cfg
+                    key = tuple(sorted((g, c.name) for g, c in cand.items()))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    cost, mem = self.evaluate(cand)
+                    cost = self._memory_penalized(cost, mem)
+                    if cost < best_cost:
+                        best, best_cost = cand, cost
+                        frontier.append(cand)
+                    elif cost < best_cost * alpha:
+                        frontier.append(cand)
+        return best
+
+    # ---------------------------------------------------- emission
+
+    def to_strategy(self, choice: dict) -> Strategy:
+        s = Strategy()
+        for node in self.order:
+            cfg = choice.get(node.guid)
+            if cfg is None or cfg.name == "dp":
+                continue
+            for i in range(len(node.outputs)):
+                s.set_output(node.name, i, cfg.out_assign)
+            declared = {ws.name for ws in node.weight_specs}
+            for wname, spec in cfg.weight_specs:
+                if wname in declared:
+                    s.set_weight(node.name, wname, spec)
+        return s
+
+
+_FEATURE_ELEMENTWISE = frozenset({
+    OT.OP_RELU, OT.OP_GELU, OT.OP_SIGMOID, OT.OP_TANH, OT.OP_ELU,
+    OT.OP_IDENTITY, OT.OP_DROPOUT, OT.OP_SCALAR_MULTIPLY, OT.OP_SCALAR_ADD,
+    OT.OP_SCALAR_SUB, OT.OP_SCALAR_TRUE_DIV, OT.OP_LAYERNORM, OT.OP_SOFTMAX,
+    OT.OP_EW_ADD, OT.OP_EW_MUL,
+})
+
+
+def search_strategy(graph, mesh, config,
+                    machine: Optional[TPUMachineModel] = None,
+                    cost_model: Optional[CostModel] = None) -> Strategy:
+    """Entry point: GRAPH_OPTIMIZE_TASK analog (graph.cc:2046). Runs the DP
+    + refinement, with the λ memory binary search when requested."""
+    from .machine_model import machine_model_for_mesh
+
+    machine = machine or machine_model_for_mesh(mesh)
+    cm = cost_model or CostModel(machine)
+    search = UnitySearch(graph, mesh, config, cm)
+    if config.perform_memory_search:
+        # λ binary search between pure-runtime and memory-lean strategies
+        # (graph_optimize_task, graph.cc:2056-2131)
+        lo, hi = 0.0, 1.0
+        best_choice = None
+        for _ in range(5):
+            mid = (lo + hi) / 2
+            search._lambda = mid
+            choice = search.run()
+            _, mem = search.evaluate(choice)
+            if mem > machine.chip.hbm_bytes:
+                lo = mid
+            else:
+                best_choice = choice
+                hi = mid
+        choice = best_choice or choice
+    else:
+        choice = search.run()
+    return search.to_strategy(choice)

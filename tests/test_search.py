@@ -1,0 +1,156 @@
+"""Unity search tests: machine model, reshard classification, the DP +
+refinement, and end-to-end search → strategy → training equivalence."""
+
+import sys
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+
+def _machine(axis_sizes):
+    from flexflow_tpu.search.machine_model import CHIPS, TPUMachineModel
+
+    return TPUMachineModel(CHIPS["v5p"], dict(axis_sizes))
+
+
+def test_collective_costs_ordering():
+    m = _machine({"data": 4, "model": 2})
+    B = 64 * 1024 * 1024  # per-chip shard bytes
+    ag = m.all_gather(B * 4, "data")  # gathered output = n * shard
+    ar = m.all_reduce(B, "data")
+    a2a = m.all_to_all(B, "data")
+    assert 0 < a2a < ag  # all_to_all moves only (n-1)/n of one shard
+    assert ar > 0
+    assert m.all_gather(B, "absent_axis") == 0.0
+    # latency grows with axis size
+    assert m.all_reduce(1, "data") > m.all_reduce(1, "model")
+
+
+def test_classify_reshard():
+    from flexflow_tpu.fftype import DataType
+    from flexflow_tpu.search.cost_model import classify_reshard
+
+    m = _machine({"data": 4, "model": 4})
+    shape = (64, 1024)
+    dp = ((("data",),) + ((),))
+    dp_feat = (("data",), ("model",))
+    # same spec: free
+    assert classify_reshard(shape, dp, dp, DataType.DT_FLOAT, m) == 0.0
+    # adding an axis (slicing) is free
+    assert classify_reshard(shape, dp, dp_feat, DataType.DT_FLOAT, m) == 0.0
+    # removing an axis costs an all_gather
+    c = classify_reshard(shape, dp_feat, dp, DataType.DT_FLOAT, m)
+    assert c > 0
+    # moving an axis between dims costs an all_to_all (cheaper than gather)
+    moved = ((), ("data",))
+    c2 = classify_reshard(shape, dp, moved, DataType.DT_FLOAT, m)
+    assert 0 < c2 < m.all_gather(64 * 1024 * 4, "data") + 1
+
+
+def _build_big_mlp(mesh_axes, hidden, strategy=None, argv=()):
+    sys.argv = ["test"] + list(argv)
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+
+    config = FFConfig()
+    config.mesh_axis_sizes = mesh_axes
+    config.batch_size = 16
+    ff = FFModel(config)
+    x = ff.create_tensor((16, 64))
+    t = ff.dense(x, hidden, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, hidden, ActiMode.AC_MODE_RELU, name="fc2")
+    t = ff.dense(t, 8, name="head")
+    t = ff.softmax(t, name="sm")
+    if strategy is not None:
+        ff.set_strategy(strategy)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def test_search_finds_tp_for_weight_heavy_mlp():
+    """Tiny batch + huge weights: DP's per-step weight allreduce dwarfs TP's
+    activation collectives, so the search must shard the big pair."""
+    from flexflow_tpu.search import CostModel, UnitySearch, machine_model_for_mesh
+
+    ff = _build_big_mlp((2, 4, 1, 1), hidden=4096,
+                        argv=["--enable-parameter-parallel"])
+    # compile already ran the search via the flag; check what it chose
+    fc1 = next(n for n in ff.graph.topo_order() if n.name == "fc1")
+    spec = fc1.weight_axes.get("kernel")
+    assert spec is not None and "model" in str(spec), (
+        f"search kept fc1 replicated: {ff._strategy}"
+    )
+
+
+def test_search_never_worse_than_dp():
+    """The chosen strategy's modeled cost must never exceed pure DP's (the
+    search starts from DP and only keeps improving moves)."""
+    sys.argv = ["test", "--budget", "4"]
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.search import CostModel, UnitySearch, machine_model_for_mesh
+
+    config = FFConfig()
+    config.mesh_axis_sizes = (4, 2, 1, 1)
+    config.batch_size = 256
+    ff = FFModel(config)
+    x = ff.create_tensor((256, 64))
+    t = ff.dense(x, 512, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 8, name="head")
+    t = ff.softmax(t, name="sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    mm = machine_model_for_mesh(ff.mesh)
+    s = UnitySearch(ff.graph, ff.mesh, config, CostModel(mm))
+    chosen = s.run()
+    dp_choice = {n.guid: s.node_configs(n)[0] for n in s.order}
+    chosen_cost, _ = s.evaluate(chosen)
+    dp_cost, _ = s.evaluate(dp_choice)
+    assert chosen_cost <= dp_cost * 1.0001
+
+
+def test_searched_strategy_trains_equivalently():
+    """The searched strategy must produce the same training result as the
+    unsharded baseline (numerics invariance of the parallelization)."""
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 64).astype(np.float32)
+    y = rs.randint(0, 8, (32, 1)).astype(np.int32)
+
+    ff_ref = _build_big_mlp((1, 1, 1, 1), hidden=256)
+    ff_tp = _build_big_mlp((2, 4, 1, 1), hidden=256,
+                           argv=["--enable-parameter-parallel", "--budget", "8"])
+    for ff in (ff_ref, ff_tp):
+        ff.fit(x, y, epochs=1, batch_size=16, shuffle=False)
+    for lname in ("fc1", "fc2", "head"):
+        np.testing.assert_allclose(
+            ff_ref.get_weight(lname, "kernel"),
+            ff_tp.get_weight(lname, "kernel"), rtol=3e-4, atol=3e-5,
+        )
+
+
+def test_bottleneck_detection():
+    sys.argv = ["test"]
+    from flexflow_tpu import ActiMode, FFConfig, FFModel
+    from flexflow_tpu.search import CostModel, UnitySearch, machine_model_for_mesh
+    from flexflow_tpu.machine import MeshShape, build_mesh
+
+    config = FFConfig()
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 16))
+    a = ff.dense(x, 16, name="a")          # bottleneck
+    b1 = ff.dense(a, 16, name="b1")        # branch
+    b2 = ff.dense(a, 16, name="b2")
+    c = ff.add(b1, b2, name="c")           # bottleneck (join)
+    d = ff.dense(c, 4, name="d")
+    # build PCG without full compile
+    from flexflow_tpu import LossType, SGDOptimizer
+
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    mesh = ff.mesh
+    mm = machine_model_for_mesh(mesh)
+    s = UnitySearch(ff.graph, mesh, config, CostModel(mm))
+    names = {n.name for n in s.bottlenecks()}
+    assert "a" in names and "c" in names
+    assert "b1" not in names and "b2" not in names
