@@ -1,0 +1,3 @@
+"""ONNX frontend (reference python/flexflow/onnx/model.py, SURVEY §2.5)."""
+
+from .model import ONNXModel

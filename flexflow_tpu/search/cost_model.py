@@ -128,7 +128,8 @@ class CostModel:
         key = (node.guid,
                tuple(tuple(a) for a in out_assigns or ()),
                tuple(sorted((k, str(v)) for k, v in
-                            (weight_specs_assigns or {}).items())))
+                            (weight_specs_assigns or {}).items())),
+               tuple(tuple(tuple(e) for e in (a or ())) for a in in_assigns))
         if key in self._cache:
             return self._cache[key]
 
@@ -139,10 +140,23 @@ class CostModel:
         out_shapes = [tuple(d.size for d in pt.shape.dims
                             if not d.is_replica_dim) for pt in node.outputs]
         full_flops = op_def.flops(node.params, list(in_shapes), out_shapes)
-        degree = 1
+        # per-chip flops shrink by every axis the computation is split over:
+        # output sharding AND reduction-dim (weight) sharding — a tp_row
+        # Linear with its kernel sharded over `model` does 1/model_deg of
+        # the contraction per chip even though its output is replicated
+        parallel_axes = set()
         if out_assigns:
-            for ax in _axes_of(out_assigns[0]):
-                degree *= axis_sizes.get(ax, 1)
+            parallel_axes |= _axes_of(out_assigns[0])
+        for spec in (weight_specs_assigns or {}).values():
+            if spec is not None:
+                for entry in spec:
+                    if entry is None:
+                        continue
+                    axes = entry if isinstance(entry, tuple) else (entry,)
+                    parallel_axes.update(axes)
+        degree = 1
+        for ax in parallel_axes:
+            degree *= axis_sizes.get(ax, 1)
         shard_flops = full_flops / max(1, degree)
 
         # bytes touched: inputs + outputs + weights per chip

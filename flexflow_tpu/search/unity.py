@@ -327,7 +327,8 @@ class UnitySearch:
         budget = self.config.search_budget or 8
         alpha = self.config.search_alpha
         best = dict(choice)
-        best_cost, _ = self.evaluate(best)
+        cost0, mem0 = self.evaluate(best)
+        best_cost = self._memory_penalized(cost0, mem0)
         frontier = [best]
         seen = set()
         for _ in range(budget):

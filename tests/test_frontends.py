@@ -1,0 +1,207 @@
+"""Frontend tests: Keras (Sequential + functional), torch.fx conversion
+(values vs torch), .ff file round-trip (SURVEY §2.5)."""
+
+import sys
+
+import numpy as np
+import pytest
+
+
+def _reset_argv():
+    sys.argv = ["test"]
+
+
+def test_keras_sequential_trains():
+    _reset_argv()
+    from flexflow_tpu.keras import Dense, Sequential
+    from flexflow_tpu.keras.optimizers import SGD
+
+    model = Sequential([
+        Dense(64, input_shape=(32,), activation="relu"),
+        Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer=SGD(learning_rate=0.1),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    rs = np.random.RandomState(0)
+    centers = rs.randn(10, 32) * 3
+    y = rs.randint(0, 10, 1024)
+    x = (centers[y] + rs.randn(1024, 32)).astype(np.float32)
+    model.fit(x, y.reshape(-1, 1).astype(np.int32), epochs=2)
+    acc = model.ffmodel.get_perf_metrics().get_accuracy()
+    assert acc >= 0.9, acc
+
+
+def test_keras_functional_merge():
+    _reset_argv()
+    from flexflow_tpu.keras import Concatenate, Dense, Input, Model
+
+    a = Input(shape=(16,), batch_size=8)
+    b = Input(shape=(16,), batch_size=8)
+    x1 = Dense(8, activation="relu")(a)
+    x2 = Dense(8, activation="relu")(b)
+    merged = Concatenate(axis=1)([x1, x2])
+    out = Dense(4, activation="softmax")(merged)
+    model = Model(inputs=[a, b], outputs=out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    rs = np.random.RandomState(0)
+    xs = [rs.randn(8, 16).astype(np.float32) for _ in range(2)]
+    ys = rs.randint(0, 4, (8, 1)).astype(np.int32)
+    model.fit(xs, ys, epochs=1, batch_size=8)
+
+
+def test_keras_cnn_builds():
+    _reset_argv()
+    from flexflow_tpu.keras import (
+        Conv2D, Dense, Flatten, MaxPooling2D, Sequential,
+    )
+
+    model = Sequential([
+        Conv2D(8, 3, strides=1, padding="same", activation="relu",
+               input_shape=(1, 28, 28)),
+        MaxPooling2D(2),
+        Flatten(),
+        Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    out_dims = model.ffmodel.layers[-1].outputs[0].dims
+    assert out_dims[-1] == 10
+
+
+def test_torch_fx_mlp_matches_torch():
+    """fx-converted model with installed weights must reproduce torch's
+    forward numerics."""
+    _reset_argv()
+    import torch
+    import torch.nn as nn
+
+    from flexflow_tpu import CompMode, FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.torch_frontend import PyTorchModel
+
+    torch.manual_seed(0)
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(20, 32)
+            self.act = nn.ReLU()
+            self.fc2 = nn.Linear(32, 6)
+
+        def forward(self, x):
+            h = self.act(self.fc1(x))
+            return self.fc2(h) + 1.0
+
+    net = Net().eval()
+    config = FFConfig()
+    config.batch_size = 4
+    ff = FFModel(config)
+    x = ff.create_tensor((4, 20), name="x")
+    conv = PyTorchModel(net)
+    (out,) = conv.torch_to_ff(ff, [x])
+    t = ff.softmax(out, name="sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.0),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    conv.install_weights(ff)
+
+    rs = np.random.RandomState(0)
+    xin = rs.randn(4, 20).astype(np.float32)
+    ff.start_batch({"x": xin}, np.zeros((4, 1), np.int32))
+    probs = np.asarray(ff.forward())
+    with torch.no_grad():
+        t_logits = net(torch.from_numpy(xin)).numpy()
+    t_probs = np.exp(t_logits) / np.exp(t_logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(probs, t_probs, rtol=1e-4, atol=1e-5)
+
+
+def test_torch_fx_cnn_converts():
+    _reset_argv()
+    import torch.nn as nn
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.torch_frontend import PyTorchModel
+
+    net = nn.Sequential(
+        nn.Conv2d(1, 4, 3, padding=1),
+        nn.ReLU(),
+        nn.MaxPool2d(2),
+        nn.Flatten(),
+        nn.Linear(4 * 14 * 14, 10),
+        nn.Softmax(dim=-1),
+    )
+    config = FFConfig()
+    config.batch_size = 2
+    ff = FFModel(config)
+    x = ff.create_tensor((2, 1, 28, 28), name="x")
+    (out,) = PyTorchModel(net).torch_to_ff(ff, [x])
+    assert out.dims == (2, 10)
+
+
+def test_torch_ff_file_roundtrip(tmp_path):
+    _reset_argv()
+    import torch.nn as nn
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.torch_frontend import PyTorchModel, torch_to_flexflow
+
+    net = nn.Sequential(
+        nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8), nn.Softmax(dim=-1),
+    )
+    path = str(tmp_path / "net.ff")
+    torch_to_flexflow(net, path)
+
+    config = FFConfig()
+    config.batch_size = 4
+    ff = FFModel(config)
+    x = ff.create_tensor((4, 16), name="x")
+    (out,) = PyTorchModel(path).torch_to_ff(ff, [x])
+    assert out.dims == (4, 8)
+    from flexflow_tpu.fftype import OperatorType as OT
+
+    kinds = [l.op_type for l in ff.layers]
+    assert kinds == [OT.OP_LINEAR, OT.OP_RELU, OT.OP_LINEAR, OT.OP_SOFTMAX]
+
+
+def test_keras_shared_layer():
+    """A layer called twice (weight-style sharing pattern) must keep both
+    edges in the functional graph."""
+    _reset_argv()
+    from flexflow_tpu.keras import Add, Dense, Input, Model
+
+    a = Input(shape=(16,), batch_size=8)
+    b = Input(shape=(16,), batch_size=8)
+    d = Dense(8, activation="relu", name="shared")
+    y1 = d(a)
+    y2 = d(b)
+    out = Dense(4, activation="softmax")(Add()([y1, y2]))
+    model = Model(inputs=[a, b], outputs=out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    from flexflow_tpu.fftype import OperatorType as OT
+
+    denses = [l for l in model.ffmodel.layers if l.op_type == OT.OP_LINEAR]
+    assert len(denses) == 3  # two materialized calls + head
+
+
+def test_torch_fx_cat_and_global_mean():
+    _reset_argv()
+    import torch
+    import torch.nn as nn
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.torch_frontend import PyTorchModel
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 8)
+            self.fc2 = nn.Linear(8, 8)
+
+        def forward(self, x):
+            z = torch.cat([self.fc1(x), self.fc2(x)], dim=1)
+            return torch.mean(z)
+
+    config = FFConfig()
+    config.batch_size = 4
+    ff = FFModel(config)
+    x = ff.create_tensor((4, 8), name="x")
+    (out,) = PyTorchModel(Net()).torch_to_ff(ff, [x])
+    assert out.dims == ()
