@@ -129,9 +129,12 @@ class Executor:
                 mesh=self.mesh,
             )
             op_state = new_state.get(node.name)
-            outs, op_state = node.op_def.forward(
-                node.params, ins, weights, op_state, ctx
-            )
+            # named_scope labels the op in XLA profiles (the analog of the
+            # reference's per-op profiling prints, linear_kernels.cu:95-117)
+            with jax.named_scope(node.name):
+                outs, op_state = node.op_def.forward(
+                    node.params, ins, weights, op_state, ctx
+                )
             if op_state:
                 op_state = dict(op_state)
                 aux = op_state.pop("aux_loss", None)

@@ -627,6 +627,10 @@ class FFModel:
                 g, self.mesh, self.config
             ).overrides
         self._assign_strategy()
+        if self.config.export_strategy_computation_graph_file:
+            from .pcg.graph import export_dot
+
+            export_dot(g, self.config.export_strategy_computation_graph_file)
 
         # --- logits node = last layer's op
         logits_node = tensor_to_out[self.layers[-1].outputs[0].tensor_guid][0]
@@ -843,6 +847,27 @@ class FFModel:
         from .dataloader import SingleDataLoader
 
         return SingleDataLoader(self, batch_tensor, full_array)
+
+    # ------------------------------------------------ checkpoint / export
+
+    def save_checkpoint(self, path: str):
+        """Sharded checkpoint of the full training state (orbax).
+        Capability beyond the reference, which has none (SURVEY §5)."""
+        from .checkpoint import save_checkpoint
+
+        return save_checkpoint(self, path)
+
+    def load_checkpoint(self, path: str):
+        from .checkpoint import restore_checkpoint
+
+        return restore_checkpoint(self, path)
+
+    def export_dot(self, path: str = "") -> str:
+        """PCG DOT export (reference --compgraph flag / print_dot)."""
+        from .pcg.graph import export_dot
+
+        assert self.graph is not None, "call compile() first"
+        return export_dot(self.graph, path or None)
 
     def print_layers(self, id: int = -1):
         for i, l in enumerate(self.layers):

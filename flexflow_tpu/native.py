@@ -1,0 +1,117 @@
+"""ctypes bindings for the native PCG core (native/src/pcg_core.cc).
+
+The reference keeps its graph/search core in C++ (SURVEY §2.1); this module
+loads our C++ equivalent, building it with make on first use (g++ is baked
+into the image; pybind11 is not, hence ctypes). Every entry point has a
+pure-Python fallback so the framework works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                           "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libpcg_core.so")
+
+_lib = None
+_lib_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    try:
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True)
+        lib = ctypes.CDLL(_LIB_PATH)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.ff_topo_order.restype = ctypes.c_int
+        lib.ff_topo_order.argtypes = [ctypes.c_int32, ctypes.c_int32,
+                                      i32p, i32p, i32p]
+        lib.ff_bottlenecks.restype = ctypes.c_int
+        lib.ff_bottlenecks.argtypes = lib.ff_topo_order.argtypes
+        lib.ff_transitive_reduction.restype = ctypes.c_int
+        lib.ff_transitive_reduction.argtypes = lib.ff_topo_order.argtypes
+        lib.ff_idominators.restype = ctypes.c_int
+        lib.ff_idominators.argtypes = lib.ff_topo_order.argtypes
+        lib.ff_eval_makespan.restype = ctypes.c_double
+        lib.ff_eval_makespan.argtypes = [
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_double)]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_i32(a):
+    return np.ascontiguousarray(a, dtype=np.int32)
+
+
+def _ptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def topo_order(n: int, src, dst) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    src, dst = _as_i32(src), _as_i32(dst)
+    out = np.zeros(n, np.int32)
+    rc = lib.ff_topo_order(n, len(src), _ptr(src), _ptr(dst), _ptr(out))
+    return out if rc == 0 else None
+
+
+def bottlenecks(n: int, src, dst) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    src, dst = _as_i32(src), _as_i32(dst)
+    mask = np.zeros(n, np.int32)
+    rc = lib.ff_bottlenecks(n, len(src), _ptr(src), _ptr(dst), _ptr(mask))
+    return mask.astype(bool) if rc >= 0 else None
+
+
+def transitive_reduction(n: int, src, dst) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    src, dst = _as_i32(src), _as_i32(dst)
+    keep = np.zeros(len(src), np.int32)
+    rc = lib.ff_transitive_reduction(n, len(src), _ptr(src), _ptr(dst),
+                                     _ptr(keep))
+    return keep.astype(bool) if rc == 0 else None
+
+
+def idominators(n: int, src, dst) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    src, dst = _as_i32(src), _as_i32(dst)
+    out = np.zeros(n, np.int32)
+    rc = lib.ff_idominators(n, len(src), _ptr(src), _ptr(dst), _ptr(out))
+    return out if rc == 0 else None
+
+
+def eval_makespan(node_costs, edge_costs) -> Optional[float]:
+    lib = _load()
+    if lib is None:
+        return None
+    nc = np.ascontiguousarray(node_costs, np.float64)
+    ec = np.ascontiguousarray(edge_costs, np.float64)
+    return lib.ff_eval_makespan(
+        len(nc), nc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(ec), ec.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))

@@ -147,3 +147,28 @@ class Graph:
 
     def __len__(self):
         return len(self.nodes)
+
+
+def export_dot(graph: "Graph", path: str | None = None) -> str:
+    """DOT export of the PCG with placements (reference print_dot /
+    export_strategy_computation_graph_file, utils/dot/*)."""
+    lines = ["digraph PCG {", '  rankdir="TB";']
+    for n in graph.topo_order():
+        spec = n.outputs[0].partition_spec() if n.outputs else ""
+        shape = n.outputs[0].shape if n.outputs else ""
+        color = "lightblue" if n.is_parallel_op else (
+            "gray90" if n.op_type.name in ("OP_INPUT", "OP_NOOP")
+            else "white")
+        lines.append(
+            f'  n{n.guid} [label="{n.name}\\n{n.op_type.name}\\n'
+            f'{shape}\\n{spec}", style=filled, fillcolor={color}];'
+        )
+    for guid, edges in graph.out_edges.items():
+        for e in edges:
+            lines.append(f"  n{e.src} -> n{e.dst};")
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot

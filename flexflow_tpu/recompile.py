@@ -1,0 +1,34 @@
+"""RecompileState: dynamic re-optimization hooks.
+
+Reference: include/flexflow/recompile.h:26-41 + recompile_state.cc:22-40 —
+a (trigger, alter) callback pair checked each iteration so a model can be
+rewritten mid-training (the MoE expert-scaling experiment, moe.cc:180-204).
+Here `alter` may change the FFModel's strategy or layer params; FFModel then
+recompiles the jitted step, which on TPU is just a new jit trace.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class RecompileState:
+    def __init__(self, trigger_func: Callable[..., bool],
+                 alter_func: Callable[..., None], ffmodel):
+        self.trigger_func = trigger_func
+        self.alter_func = alter_func
+        self.ffmodel = ffmodel
+        self.recompilations = 0
+
+    def trigger(self) -> bool:
+        return bool(self.trigger_func(self.ffmodel))
+
+    def alter(self):
+        self.alter_func(self.ffmodel)
+        # invalidate the compiled step so the next fit() retraces
+        ex = self.ffmodel.executor
+        if ex is not None:
+            ex._train_step = None
+            ex._eval_step = None
+            ex._forward_fn = None
+        self.recompilations += 1

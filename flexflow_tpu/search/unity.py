@@ -211,9 +211,21 @@ class UnitySearch:
 
     def bottlenecks(self) -> list:
         """Nodes every source→sink path crosses (the sequence-split points,
-        graph.cc find_bottleneck_node)."""
+        graph.cc find_bottleneck_node). Uses the native C++ core when
+        available; pure-Python fallback otherwise."""
         order = [n for n in self.order]
-        idx = {n.guid: i for i, n in enumerate(order)}
+        from .. import native
+
+        if native.available():
+            idx = {n.guid: i for i, n in enumerate(order)}
+            src, dst = [], []
+            for edges in self.graph.out_edges.values():
+                for e in edges:
+                    src.append(idx[e.src])
+                    dst.append(idx[e.dst])
+            mask = native.bottlenecks(len(order), src, dst)
+            if mask is not None:
+                return [n for i, n in enumerate(order) if mask[i]]
         out = []
         open_edges = 0
         for i, n in enumerate(order):

@@ -1,0 +1,163 @@
+// PCG graph-algorithm core (C++17, no deps).
+//
+// Native equivalent of the reference's C++ graph utilities
+// (include/flexflow/dominators.h, basic_graph.h, graph_structures.h and the
+// bottleneck/sequence-split machinery in src/runtime/graph.cc) — the parts
+// of the runtime the reference keeps native and unit-tests natively
+// (tests/unit/test_dominators.cc). Exposed as a C ABI consumed from Python
+// via ctypes (no pybind11 in this image).
+//
+// All functions take the graph as CSR-ish arrays: n nodes (0..n-1 in
+// topological candidate order not required), m edges (src[i] -> dst[i]).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+extern "C" {
+
+// Topological order. Returns 0 on success, -1 if the graph has a cycle.
+// Deterministic: among ready nodes, smallest id first.
+int ff_topo_order(int32_t n, int32_t m, const int32_t* src,
+                  const int32_t* dst, int32_t* out_order) {
+  std::vector<std::vector<int32_t>> adj(n);
+  std::vector<int32_t> indeg(n, 0);
+  for (int32_t i = 0; i < m; i++) {
+    adj[src[i]].push_back(dst[i]);
+    indeg[dst[i]]++;
+  }
+  std::priority_queue<int32_t, std::vector<int32_t>, std::greater<int32_t>>
+      ready;
+  for (int32_t v = 0; v < n; v++)
+    if (indeg[v] == 0) ready.push(v);
+  int32_t k = 0;
+  while (!ready.empty()) {
+    int32_t v = ready.top();
+    ready.pop();
+    out_order[k++] = v;
+    for (int32_t w : adj[v])
+      if (--indeg[w] == 0) ready.push(w);
+  }
+  return k == n ? 0 : -1;
+}
+
+// Bottleneck nodes: nodes every source->sink path crosses (the reference's
+// sequence-split points, graph.cc find_bottleneck_node). out_mask[v] = 1 if
+// v is a bottleneck. The last node in topo order is excluded (a cut there
+// splits nothing). Returns count, or -1 on cycle.
+int ff_bottlenecks(int32_t n, int32_t m, const int32_t* src,
+                   const int32_t* dst, int32_t* out_mask) {
+  std::vector<int32_t> order(n);
+  if (ff_topo_order(n, m, src, dst, order.data()) != 0) return -1;
+  std::vector<int32_t> pos(n);
+  for (int32_t i = 0; i < n; i++) pos[order[i]] = i;
+  std::vector<int32_t> in_cnt(n, 0), out_cnt(n, 0);
+  for (int32_t i = 0; i < m; i++) {
+    out_cnt[src[i]]++;
+    in_cnt[dst[i]]++;
+  }
+  std::memset(out_mask, 0, sizeof(int32_t) * n);
+  int64_t open_edges = 0;
+  int32_t count = 0;
+  for (int32_t i = 0; i < n; i++) {
+    int32_t v = order[i];
+    open_edges -= in_cnt[v];
+    if (open_edges == 0 && i < n - 1) {
+      out_mask[v] = 1;
+      count++;
+    }
+    open_edges += out_cnt[v];
+  }
+  return count;
+}
+
+// Transitive reduction: out_keep[i] = 0 for edge i if a longer path
+// src[i] ->* dst[i] exists (the reference's contract_out_edge /
+// transitive reduction pass). O(m * (n + m)) bitset BFS.
+int ff_transitive_reduction(int32_t n, int32_t m, const int32_t* src,
+                            const int32_t* dst, int32_t* out_keep) {
+  std::vector<std::vector<int32_t>> adj(n);
+  for (int32_t i = 0; i < m; i++) adj[src[i]].push_back(dst[i]);
+  // reach[v] = bitset of nodes reachable from v via paths of length >= 1
+  int32_t words = (n + 63) / 64;
+  std::vector<uint64_t> reach((size_t)n * words, 0);
+  std::vector<int32_t> order(n);
+  if (ff_topo_order(n, m, src, dst, order.data()) != 0) return -1;
+  for (int32_t i = n - 1; i >= 0; i--) {
+    int32_t v = order[i];
+    uint64_t* rv = &reach[(size_t)v * words];
+    for (int32_t w : adj[v]) {
+      rv[w / 64] |= (1ull << (w % 64));
+      const uint64_t* rw = &reach[(size_t)w * words];
+      for (int32_t k = 0; k < words; k++) rv[k] |= rw[k];
+    }
+  }
+  for (int32_t i = 0; i < m; i++) {
+    out_keep[i] = 1;
+    int32_t s = src[i], d = dst[i];
+    // drop if any other out-neighbor of s reaches d
+    for (int32_t w : adj[s]) {
+      if (w == d) continue;
+      if (reach[(size_t)w * words + d / 64] & (1ull << (d % 64))) {
+        out_keep[i] = 0;
+        break;
+      }
+    }
+  }
+  return 0;
+}
+
+// Immediate dominators over the DAG (reference dominators.h). Entry nodes
+// (in-degree 0) get idom = -1. Multi-source graphs use a virtual root, also
+// reported as -1. Returns 0, or -1 on cycle.
+int ff_idominators(int32_t n, int32_t m, const int32_t* src,
+                   const int32_t* dst, int32_t* out_idom) {
+  std::vector<int32_t> order(n);
+  if (ff_topo_order(n, m, src, dst, order.data()) != 0) return -1;
+  std::vector<int32_t> pos(n);
+  for (int32_t i = 0; i < n; i++) pos[order[i]] = i;
+  std::vector<std::vector<int32_t>> preds(n);
+  for (int32_t i = 0; i < m; i++) preds[dst[i]].push_back(src[i]);
+  std::vector<int32_t> idom(n, -2);  // -2 = unset, -1 = root
+  auto intersect = [&](int32_t a, int32_t b) {
+    while (a != b) {
+      if (a == -1 || b == -1) return (int32_t)-1;
+      while (a != b && pos[a] > pos[b]) a = idom[a] >= 0 ? idom[a] : -1;
+      if (a == -1) return (int32_t)-1;
+      while (b != a && pos[b] > pos[a]) b = idom[b] >= 0 ? idom[b] : -1;
+      if (b == -1) return (int32_t)-1;
+    }
+    return a;
+  };
+  for (int32_t i = 0; i < n; i++) {
+    int32_t v = order[i];
+    if (preds[v].empty()) {
+      idom[v] = -1;
+      continue;
+    }
+    int32_t d = preds[v][0];
+    for (size_t j = 1; j < preds[v].size(); j++)
+      d = intersect(d, preds[v][j]);
+    idom[v] = d;
+  }
+  std::memcpy(out_idom, idom.data(), sizeof(int32_t) * n);
+  return 0;
+}
+
+// Strategy-evaluation hot loop for the Unity search: given per-node config
+// choices as precomputed cost tables, accumulate the makespan. Layout:
+//   node_cost[i]   = compute+sync cost of node i under its chosen config
+//   edge_cost[e]   = reshard cost of edge e under (src config, dst config)
+// This exists so Python can offload the O(nodes+edges) inner loop of
+// best-first refinement (thousands of evaluations) to native code.
+double ff_eval_makespan(int32_t n, const double* node_cost, int32_t m,
+                        const double* edge_cost) {
+  double total = 0.0;
+  for (int32_t i = 0; i < n; i++) total += node_cost[i];
+  for (int32_t e = 0; e < m; e++) total += edge_cost[e];
+  return total;
+}
+
+}  // extern "C"

@@ -1,0 +1,87 @@
+"""Native C++ PCG core vs pure-Python reference implementations (mirrors
+the reference's tests/unit/test_dominators.cc fixtures)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib_ok():
+    if not native.available():
+        pytest.skip("native core unavailable (no toolchain)")
+
+
+def test_topo_order(lib_ok):
+    # diamond: 0 -> {1,2} -> 3
+    order = native.topo_order(4, [0, 0, 1, 2], [1, 2, 3, 3])
+    assert order is not None
+    pos = {v: i for i, v in enumerate(order)}
+    assert pos[0] < pos[1] < pos[3] and pos[0] < pos[2] < pos[3]
+
+
+def test_topo_cycle_detected(lib_ok):
+    assert native.topo_order(2, [0, 1], [1, 0]) is None
+
+
+def test_bottlenecks_diamond(lib_ok):
+    # 0 -> {1,2} -> 3 -> 4 : bottlenecks are 0 and 3 (not 4, the sink)
+    mask = native.bottlenecks(5, [0, 0, 1, 2, 3], [1, 2, 3, 3, 4])
+    assert list(np.nonzero(mask)[0]) == [0, 3]
+
+
+def test_transitive_reduction(lib_ok):
+    # 0->1, 1->2, 0->2 : the shortcut 0->2 must drop
+    keep = native.transitive_reduction(3, [0, 1, 0], [1, 2, 2])
+    assert list(keep) == [True, True, False]
+
+
+def test_idominators_multisource(lib_ok):
+    # reference test_dominators.cc multisource fixture:
+    # 0->2, 1->2, 2->3, 2->4, 3->5, 4->5
+    idom = native.idominators(6, [0, 1, 2, 2, 3, 4], [2, 2, 3, 4, 5, 5])
+    assert idom[0] == -1 and idom[1] == -1
+    assert idom[2] == -1  # joined from two roots -> virtual root
+    assert idom[3] == 2 and idom[4] == 2
+    assert idom[5] == 2  # 3 and 4 intersect at 2
+
+
+def test_bottlenecks_matches_python_on_real_graph(lib_ok):
+    import sys
+
+    sys.argv = ["test"]
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.search import CostModel, UnitySearch, machine_model_for_mesh
+
+    config = FFConfig()
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 16))
+    a = ff.dense(x, 16, name="a")
+    b1 = ff.dense(a, 16, name="b1")
+    b2 = ff.relu(a, name="b2")
+    c = ff.add(b1, b2, name="c")
+    d = ff.dense(c, 4, name="d")
+    ff.softmax(d, name="sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    s = UnitySearch(ff.graph, ff.mesh, config,
+                    CostModel(machine_model_for_mesh(ff.mesh)))
+    native_names = {n.name for n in s.bottlenecks()}
+
+    # force the Python fallback
+    import flexflow_tpu.native as nat
+
+    saved = nat._lib
+    nat._lib = None
+    nat._lib_tried = True
+    try:
+        py_names = {n.name for n in s.bottlenecks()}
+    finally:
+        nat._lib = saved
+    assert native_names == py_names
+
+
+def test_eval_makespan(lib_ok):
+    total = native.eval_makespan([1.0, 2.0, 3.0], [0.5, 0.5])
+    assert total == pytest.approx(7.0)
