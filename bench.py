@@ -66,6 +66,11 @@ def main():
 
     config = FFConfig()
     config.batch_size = batch
+    if on_tpu:
+        # full mixed-precision policy: bf16 activations, fp32 master weights
+        from flexflow_tpu.fftype import DataType
+
+        config.computation_dtype = DataType.DT_BFLOAT16
     ff = FFModel(config)
     build_transformer_lm(ff, cfg, batch_size=batch)
     ff.compile(optimizer=SGDOptimizer(lr=0.01),

@@ -17,7 +17,7 @@ from typing import Optional
 
 import jax
 
-from .fftype import CompMode
+from .fftype import CompMode, DataType
 from .machine import DEFAULT_AXES, MeshShape
 
 
@@ -56,7 +56,15 @@ class FFConfig:
     profiling: bool = False
     perform_fusion: bool = False
     synthetic_input: bool = False
-    allow_tensor_op_math_conversion: bool = True  # → bf16 matmuls on MXU
+    # Mixed precision. allow_tensor_op_math_conversion is the reference's
+    # cublas tensor-op flag recast for the MXU: fp32 matmul *inputs* are cast
+    # to bf16 with fp32 accumulation (applies on TPU; force_tensor_op_math
+    # extends it to CPU for tests). computation_dtype=DT_BFLOAT16 is the full
+    # policy: bf16 activations end-to-end with fp32 master weights, optimizer
+    # state, loss, and normalization statistics.
+    allow_tensor_op_math_conversion: bool = True
+    force_tensor_op_math: bool = False
+    computation_dtype: Optional[DataType] = None  # None → fp32 activations
     # files / misc
     dataset_path: str = ""
     import_strategy_file: str = ""
@@ -183,6 +191,22 @@ class FFConfig:
                 self.seed = int(val())
             elif a == "--synthetic-input":
                 self.synthetic_input = True
+            elif a == "--allow-tensor-op-math-conversion":
+                self.allow_tensor_op_math_conversion = True
+            elif a == "--dtype":
+                d = val().lower()
+                table = {
+                    "bf16": DataType.DT_BFLOAT16,
+                    "bfloat16": DataType.DT_BFLOAT16,
+                    "fp16": DataType.DT_HALF,
+                    "half": DataType.DT_HALF,
+                    "fp32": None,
+                    "float32": None,
+                }
+                if d not in table:
+                    raise ValueError(
+                        f"--dtype {d!r}: expected one of {sorted(table)}")
+                self.computation_dtype = table[d]
             # unknown flags are ignored, matching the reference's tolerant scan
             i += 1
 

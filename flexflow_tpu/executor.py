@@ -28,7 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .config import FFConfig
-from .fftype import CompMode, LossType, OperatorType as OT, dtype_to_jnp
+from .fftype import CompMode, DataType, LossType, OperatorType as OT, dtype_to_jnp
 from .initializer import initializer_by_name
 from .loss import loss_value
 from .metrics import Metrics
@@ -64,9 +64,64 @@ class Executor:
         self.logits_node = logits_node
         self.label_spec = label_spec
         self.last_op_is_softmax = logits_node.op_type == OT.OP_SOFTMAX
+        # Mixed precision (config.py): compute_dtype != None → bf16/fp16
+        # activations with fp32 master weights; matmul_dtype → MXU input cast
+        # for fp32 matmuls (tensor-op math analog).
+        self.compute_dtype = (
+            dtype_to_jnp(config.computation_dtype)
+            if config.computation_dtype is not None else None
+        )
+        self.matmul_dtype = (
+            jnp.bfloat16
+            if config.allow_tensor_op_math_conversion
+            and (jax.default_backend() == "tpu" or config.force_tensor_op_math)
+            else None
+        )
         self._train_step = None
         self._eval_step = None
         self._forward_fn = None
+
+    def _cast_compute(self, tree):
+        """Cast float leaves to the compute dtype (inside jit; the VJP of the
+        cast accumulates gradients back into the fp32 master leaves)."""
+        cd = self.compute_dtype
+        if cd is None:
+            return tree
+        return jax.tree.map(
+            lambda x: x.astype(cd)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            tree,
+        )
+
+    def make_loss_fn(self, state, x_inputs, labels, rng):
+        """Shared mixed-precision loss closure for the fused train step and
+        the granular FFModel.backward: bf16 compute casts on params/inputs
+        (state is passed uncast — ops own their fp32-statistics handling),
+        fp32 logits into the loss."""
+        xc = self._cast_compute(x_inputs)
+
+        def loss_fn(p):
+            logits, new_state, aux = self._apply(
+                self._cast_compute(p), state, xc, training=True, rng=rng
+            )
+            logits = logits.astype(jnp.float32)
+            l = loss_value(
+                self.loss_type, logits, labels, self.last_op_is_softmax
+            )
+            return l + aux, (logits, new_state)
+
+        return loss_fn
+
+    def _restore_state_dtypes(self, new_state):
+        """Non-trainable state (running stats) is kept fp32 across steps so
+        its dtype — and therefore the jitted step signature — is stable."""
+        if self.compute_dtype is None:
+            return new_state
+        return jax.tree.map(
+            lambda x: x.astype(jnp.float32)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            new_state,
+        )
 
     # ------------------------------------------------------------ variables
 
@@ -127,6 +182,7 @@ class Executor:
                 seq_length=seq_length,
                 profiling=self.config.profiling,
                 mesh=self.mesh,
+                matmul_dtype=self.matmul_dtype,
             )
             op_state = new_state.get(node.name)
             # named_scope labels the op in XLA profiles (the analog of the
@@ -165,19 +221,11 @@ class Executor:
 
         def train_step(params, state, opt_slots, step, counters, rng, batch):
             x_inputs, labels = batch
-
-            def loss_fn(p):
-                logits, new_state, aux = self._apply(
-                    p, state, x_inputs, training=True, rng=rng
-                )
-                l = loss_value(
-                    self.loss_type, logits, labels, self.last_op_is_softmax
-                )
-                return l + aux, (logits, new_state)
-
+            loss_fn = self.make_loss_fn(state, x_inputs, labels, rng)
             (lval, (logits, new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
+            new_state = self._restore_state_dtypes(new_state)
             new_params, new_slots = self.optimizer.update(
                 grads, params, opt_slots, step
             )
@@ -191,9 +239,12 @@ class Executor:
         def eval_step(params, state, counters, batch):
             x_inputs, labels = batch
             logits, _, _ = self._apply(
-                params, state, x_inputs, training=False, rng=None
+                self._cast_compute(params), state,
+                self._cast_compute(x_inputs), training=False, rng=None,
             )
-            counters = self.metrics.compute(counters, logits, labels)
+            counters = self.metrics.compute(
+                counters, logits.astype(jnp.float32), labels
+            )
             return counters
 
         self._eval_step = jax.jit(eval_step, donate_argnums=_donate_argnums((2,)))
@@ -202,9 +253,11 @@ class Executor:
     def build_forward(self):
         def forward(params, state, x_inputs, training):
             logits, new_state, _ = self._apply(
-                params, state, x_inputs, training=training, rng=jax.random.key(0)
+                self._cast_compute(params), state,
+                self._cast_compute(x_inputs), training=training,
+                rng=jax.random.key(0),
             )
-            return logits, new_state
+            return logits, self._restore_state_dtypes(new_state)
 
         self._forward_fn = jax.jit(forward, static_argnums=(3,))
         return self._forward_fn

@@ -796,16 +796,11 @@ class FFModel:
     def backward(self, seq_length: int = -1):
         assert self._current_batch is not None
         xs, labels = self._current_batch
+        inner = self.executor.make_loss_fn(self._state, xs, labels, self._rng)
 
         def loss_fn(p):
-            logits, _, aux = self.executor._apply(
-                p, self._state, xs, training=True, rng=self._rng
-            )
-            return (
-                loss_value(self.loss_type, logits, labels,
-                           self.executor.last_op_is_softmax) + aux,
-                logits,
-            )
+            l, (logits, _) = inner(p)
+            return l, logits
 
         (lval, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(self._params)
         self._grads = grads

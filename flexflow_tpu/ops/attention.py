@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..fftype import DataType, OperatorType as OT
-from .base import OpDef, WeightSpec, register_op
+from .base import OpDef, WeightSpec, matmul_cast, register_op
 
 
 @dataclass(frozen=True)
@@ -95,9 +95,10 @@ def _mha_forward(p: MultiHeadAttentionParams, inputs, weights, state, ctx):
     hd = E // H
 
     def proj(x, w, b):
-        y = jnp.dot(x, w.astype(x.dtype), preferred_element_type=jnp.float32).astype(x.dtype)
+        xm, wm = matmul_cast(ctx, x, w.astype(x.dtype))
+        y = jnp.dot(xm, wm, preferred_element_type=jnp.float32).astype(x.dtype)
         if b is not None:
-            y = y + b
+            y = y + b.astype(y.dtype)
         return y
 
     q = proj(q_in, weights["wq"], weights.get("bq"))

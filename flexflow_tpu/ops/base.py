@@ -47,6 +47,23 @@ class OpContext:
     seq_length: int = -1
     profiling: bool = False
     mesh: Any = None  # global jax Mesh (for ops lowering to shard_map)
+    # MXU input dtype for matmul/conv when activations are fp32 — the TPU
+    # analog of the reference's cublas tensor-op math mode
+    # (allow_tensor_op_math_conversion, include/flexflow/config.h): inputs
+    # are cast to this dtype, accumulation stays fp32.
+    matmul_dtype: Any = None
+
+
+def matmul_cast(ctx: OpContext, *arrays):
+    """Cast fp32 matmul operands to the MXU input dtype (no-op when the
+    policy is off or activations are already low-precision)."""
+    md = getattr(ctx, "matmul_dtype", None)
+    if md is None:
+        return arrays if len(arrays) > 1 else arrays[0]
+    import jax.numpy as jnp
+
+    out = tuple(a.astype(md) if a.dtype == jnp.float32 else a for a in arrays)
+    return out if len(out) > 1 else out[0]
 
 
 class OpDef:

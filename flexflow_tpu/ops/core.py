@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from ..fftype import ActiMode, AggrMode, DataType, OperatorType as OT, PoolType, RegularizerMode
-from .base import OpDef, WeightSpec, register_op
+from .base import OpDef, WeightSpec, matmul_cast, register_op
 
 
 def apply_activation(x, activation: ActiMode):
@@ -68,7 +68,8 @@ def _linear_weights(p: LinearParams, in_shapes):
 
 def _linear_forward(p: LinearParams, inputs, weights, state, ctx):
     (x,) = inputs
-    y = jnp.dot(x, weights["kernel"], preferred_element_type=jnp.float32)
+    xm, km = matmul_cast(ctx, x, weights["kernel"])
+    y = jnp.dot(xm, km, preferred_element_type=jnp.float32)
     y = y.astype(x.dtype)
     if p.use_bias:
         y = y + weights["bias"]
@@ -128,6 +129,10 @@ def _conv2d_weights(p: Conv2DParams, in_shapes):
 
 def _conv2d_forward(p: Conv2DParams, inputs, weights, state, ctx):
     (x,) = inputs
+    x = matmul_cast(ctx, x)
+    # same-dtype conv without preferred_element_type: lax.conv's transpose
+    # (VJP) requires matching operand dtypes, and the MXU accumulates fp32
+    # internally for bf16 convs regardless of the output element type
     y = jax.lax.conv_general_dilated(
         x,
         weights["kernel"].astype(x.dtype),
@@ -135,10 +140,9 @@ def _conv2d_forward(p: Conv2DParams, inputs, weights, state, ctx):
         padding=[(p.padding_h, p.padding_h), (p.padding_w, p.padding_w)],
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=p.groups,
-        preferred_element_type=jnp.float32,
-    ).astype(x.dtype)
+    ).astype(inputs[0].dtype)
     if p.use_bias:
-        y = y + weights["bias"][None, :, None, None]
+        y = y + weights["bias"][None, :, None, None].astype(y.dtype)
     return [apply_activation(y, p.activation)], state
 
 
@@ -231,21 +235,27 @@ def _bn_weights(p: BatchNormParams, in_shapes):
 def _bn_forward(p: BatchNormParams, inputs, weights, state, ctx):
     (x,) = inputs
     axes = (0, 2, 3)
+    # statistics always in fp32 (mixed-precision policy: bf16 mean/var
+    # accumulation loses too many mantissa bits)
+    xf = x.astype(jnp.float32)
     if ctx.training:
-        mean = jnp.mean(x, axes)
-        var = jnp.var(x, axes)
+        mean = jnp.mean(xf, axes)
+        var = jnp.var(xf, axes)
         state = dict(state or {})
         state["running_mean"] = (
-            (1 - p.momentum) * weights["running_mean"] + p.momentum * mean
+            (1 - p.momentum) * weights["running_mean"].astype(jnp.float32)
+            + p.momentum * mean
         )
         state["running_var"] = (
-            (1 - p.momentum) * weights["running_var"] + p.momentum * var
+            (1 - p.momentum) * weights["running_var"].astype(jnp.float32)
+            + p.momentum * var
         )
     else:
-        mean = weights["running_mean"]
-        var = weights["running_var"]
+        mean = weights["running_mean"].astype(jnp.float32)
+        var = weights["running_var"].astype(jnp.float32)
     inv = jax.lax.rsqrt(var + p.eps)
-    y = (x - mean[None, :, None, None]) * inv[None, :, None, None]
+    y = (xf - mean[None, :, None, None]) * inv[None, :, None, None]
+    y = y.astype(x.dtype)
     y = y * weights["scale"][None, :, None, None] + weights["bias"][None, :, None, None]
     if p.relu:
         y = jax.nn.relu(y)
@@ -281,9 +291,10 @@ def _ln_weights(p: LayerNormParams, in_shapes):
 def _ln_forward(p: LayerNormParams, inputs, weights, state, ctx):
     (x,) = inputs
     axes = tuple(a % x.ndim for a in p.axes)
-    mean = jnp.mean(x, axes, keepdims=True)
-    var = jnp.var(x, axes, keepdims=True)
-    y = (x - mean) * jax.lax.rsqrt(var + p.eps)
+    xf = x.astype(jnp.float32)  # fp32 statistics under mixed precision
+    mean = jnp.mean(xf, axes, keepdims=True)
+    var = jnp.var(xf, axes, keepdims=True)
+    y = ((xf - mean) * jax.lax.rsqrt(var + p.eps)).astype(x.dtype)
     if p.elementwise_affine:
         bshape = [x.shape[a] if a in axes else 1 for a in range(x.ndim)]
         y = y * weights["scale"].reshape(bshape) + weights["bias"].reshape(bshape)
@@ -306,7 +317,9 @@ def _softmax_infer(p, in_shapes):
 
 def _softmax_forward(p: SoftmaxParams, inputs, weights, state, ctx):
     (x,) = inputs
-    return [jax.nn.softmax(x, axis=p.dim)], state
+    # fp32 exponentials/normalization, output back in the activation dtype
+    y = jax.nn.softmax(x.astype(jnp.float32), axis=p.dim).astype(x.dtype)
+    return [y], state
 
 
 register_op(OpDef(OT.OP_SOFTMAX, _softmax_infer, _softmax_forward))
@@ -362,7 +375,8 @@ def _bmm_forward(p: BatchMatmulParams, inputs, weights, state, ctx):
             a = jax.lax.slice_in_dim(a, 0, ctx.seq_length, axis=p.a_seq_length_dim)
         if p.b_seq_length_dim >= 0:
             b = jax.lax.slice_in_dim(b, 0, ctx.seq_length, axis=p.b_seq_length_dim)
-    y = jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    am, bm = matmul_cast(ctx, a, b)
+    y = jnp.matmul(am, bm, preferred_element_type=jnp.float32).astype(a.dtype)
     return [y], state
 
 
