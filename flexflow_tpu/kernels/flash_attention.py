@@ -9,9 +9,12 @@ max/denominator carried in VMEM scratch across kv steps. The MXU sees two
 large matmuls per tile; HBM traffic is O(s*d) instead of the O(s^2)
 materialized-probabilities tensor XLA would allocate at long sequence.
 
-Backward currently recomputes attention under autodiff via the XLA einsum
-path (correct, memory O(s^2) per block pair at trace level but XLA re-tiles
-it); a dedicated Pallas backward is a planned optimization.
+Backward is the FlashAttention-2 scheme as two Pallas kernels: the forward
+saves per-row logsumexp; `delta = rowsum(dO*O)` is a cheap XLA elementwise
+precompute; the dq kernel iterates kv-blocks per q-block and the dk/dv
+kernel iterates q-blocks per kv-block, both recomputing the probability
+tile from (q, k, lse) so nothing O(s^2) ever touches HBM. Causal block
+skipping applies on both sides of the diagonal.
 
 On non-TPU backends (the 8-device CPU test mesh) the kernel runs in Pallas
 interpret mode so tests exercise the same code path.
@@ -28,6 +31,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+LSE_LANES = 8  # minor dim of the (seq,) row-stat tensors for TPU tiling
 
 
 def _attn_reference(q, k, v, causal: bool, scale: float):
@@ -41,7 +45,7 @@ def _attn_reference(q, k, v, causal: bool, scale: float):
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int, seq_k: int,
     causal_offset: int,
 ):
@@ -105,6 +109,11 @@ def _flash_kernel(
     @pl.when(j == nj - 1)
     def _finish():
         o_ref[0] = (acc_ref[...] / l_ref[...][:, None]).astype(o_ref.dtype)
+        # row stats carry a minor dim of LSE_LANES so the block is
+        # tile-legal on TPU (same trick as jax's in-tree flash kernel,
+        # which uses MIN_BLOCK_SIZE lanes)
+        lse = m_ref[...] + jnp.log(l_ref[...])
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref[0].shape)
 
 
 def _flash_fwd(q, k, v, causal: bool, scale: float,
@@ -121,7 +130,7 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
         _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         seq_k=s_k, causal_offset=s_k - s_q,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -129,8 +138,14 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s_q, LSE_LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq,), jnp.float32),
             pltpu.VMEM((bq,), jnp.float32),
@@ -142,24 +157,226 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
         interpret=jax.default_backend() != "tpu",
         name="flash_attention_fwd",
     )(qf, kf, vf)
-    return out.reshape(b, h, s_q, d)
+    return out.reshape(b, h, s_q, d), lse
+
+
+def _bwd_tile(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i, j,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+    seq_q: int, seq_k: int, causal_offset: int, mask_q_rows: bool,
+):
+    """Shared backward tile recompute: zero garbage padded rows (NaN in
+    interpret mode, 0*NaN poisons contractions), rebuild the probability
+    tile p from (q, k, lse), and form ds = p*(dp - delta)*scale.
+
+    mask_q_rows additionally joins q-row validity into the probability mask:
+    padded q rows have p == exp(0-0) == 1 and must not leak into reductions
+    over the q axis (dk/dv); reductions over the kv axis (dq) don't need it
+    because their padded output rows are discarded on write."""
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, 0]
+    delta = delta_ref[0][:, 0]
+    q_valid = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0
+    ) + i * block_q < seq_q
+    q = jnp.where(q_valid, q, 0.0)
+    do = jnp.where(q_valid, do, 0.0)
+    lse = jnp.where(q_valid[:, 0], lse, 0.0)
+    delta = jnp.where(q_valid[:, 0], delta, 0.0)
+    kv_valid = jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, 1), 0
+    ) + j * block_k < seq_k
+    k = jnp.where(kv_valid, k, 0.0)
+    v = jnp.where(kv_valid, v, 0.0)
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    k_pos = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    ) + j * block_k
+    mask = k_pos < seq_k
+    if mask_q_rows:
+        mask = mask & q_valid
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        ) + i * block_q
+        mask = mask & (q_pos + causal_offset >= k_pos)
+    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[:, None]) * scale
+    return q, k, v, do, p, ds
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+    seq_q: int, seq_k: int, causal_offset: int,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    live = (
+        (j * block_k <= i * block_q + block_q - 1 + causal_offset)
+        if causal else True
+    )
+
+    @pl.when(live)
+    def _step():
+        q, k, _, do, p, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            seq_q=seq_q, seq_k=seq_k, causal_offset=causal_offset,
+            mask_q_rows=False,  # padded dq rows are discarded on write
+        )
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+    seq_q: int, seq_k: int, causal_offset: int,
+):
+    j = pl.program_id(1)  # kv block
+    i = pl.program_id(2)  # q block (innermost, sequential)
+    ni = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # a q block contributes to this kv block unless it lies entirely above
+    # the causal diagonal
+    live = (
+        (i * block_q + block_q - 1 + causal_offset >= j * block_k)
+        if causal else True
+    )
+
+    @pl.when(live)
+    def _step():
+        q, _, _, do, p, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            seq_q=seq_q, seq_k=seq_k, causal_offset=causal_offset,
+            mask_q_rows=True,  # padded q rows would leak p==1 into dk/dv
+        )
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == ni - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    bq = min(block_q, s_q)
+    bk = min(block_k, s_k)
+    qf = q.reshape(b * h, s_q, d)
+    kf = k.reshape(b * h, s_k, d)
+    vf = v.reshape(b * h, s_k, d)
+    gf = g.reshape(b * h, s_q, d)
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise XLA precompute
+    delta = jnp.sum(
+        gf.astype(jnp.float32) * out.reshape(b * h, s_q, d).astype(jnp.float32),
+        axis=-1,
+    )
+    delta = jnp.broadcast_to(delta[..., None], (b * h, s_q, LSE_LANES))
+    interpret = jax.default_backend() != "tpu"
+    common = dict(
+        scale=scale, causal=causal, block_q=bq, block_k=bk,
+        seq_q=s_q, seq_k=s_k, causal_offset=s_k - s_q,
+    )
+    qspec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))
+    kspec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0))
+    rowspec = pl.BlockSpec((1, bq, LSE_LANES), lambda bh, i, j: (bh, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b * h, pl.cdiv(s_q, bq), pl.cdiv(s_k, bk)),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+        name="flash_attention_bwd_dq",
+    )(qf, kf, vf, gf, lse, delta)
+    # kv-grid kernel: block index maps take (bh, kv_j, q_i)
+    qspec2 = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0))
+    kspec2 = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))
+    rowspec2 = pl.BlockSpec((1, bq, LSE_LANES), lambda bh, j, i: (bh, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(b * h, pl.cdiv(s_k, bk), pl.cdiv(s_q, bq)),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+        name="flash_attention_bwd_dkv",
+    )(qf, kf, vf, gf, lse, delta)
+    return (
+        dq.reshape(b, h, s_q, d),
+        dk.reshape(b, h, s_k, d),
+        dv.reshape(b, h, s_k, d),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, scale, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _attn_reference(q_, k_, v_, causal, scale), q, k, v
-    )
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

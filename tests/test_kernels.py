@@ -100,3 +100,77 @@ def test_flash_attention_cross_causal_alignment():
                           block_q=64, block_k=64)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(256, 256), (320, 320), (128, 256), (320, 192)])
+def test_flash_backward_matches_reference(causal, sq, sk):
+    """Pallas dq/dk/dv kernels vs XLA autodiff of the reference attention,
+    including ragged and cross-length causal shapes."""
+    from flexflow_tpu.kernels.flash_attention import (
+        _attn_reference,
+        flash_attention,
+    )
+
+    if causal and sk < sq:
+        pytest.skip("bottom-right causal with sk<sq leaves rows keyless")
+    rs = np.random.RandomState(4)
+    b, h, d = 2, 2, 16
+    q = jnp.asarray(rs.randn(b, h, sq, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, h, sk, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, h, sk, d), jnp.float32)
+    ct = jnp.asarray(rs.randn(b, h, sq, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    _, vjp_flash = jax.vjp(
+        lambda q_, k_, v_: flash_attention(
+            q_, k_, v_, causal=causal, scale=scale, block_q=128, block_k=128
+        ), q, k, v,
+    )
+    _, vjp_ref = jax.vjp(
+        lambda q_, k_, v_: _attn_reference(q_, k_, v_, causal, scale), q, k, v
+    )
+    for got, want, name in zip(vjp_flash(ct), vjp_ref(ct), "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name} mismatch sq={sq} sk={sk} causal={causal}",
+        )
+
+
+def test_flash_backward_bf16():
+    """bf16 inputs: backward runs in the kernel path and tracks the fp32
+    reference to bf16 tolerance."""
+    from flexflow_tpu.kernels.flash_attention import (
+        _attn_reference,
+        flash_attention,
+    )
+
+    rs = np.random.RandomState(5)
+    b, h, s, d = 1, 2, 256, 32
+    qf = rs.randn(b, h, s, d).astype(np.float32)
+    kf = rs.randn(b, h, s, d).astype(np.float32)
+    vf = rs.randn(b, h, s, d).astype(np.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, scale=scale,
+                            block_q=128, block_k=128).astype(jnp.float32) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            _attn_reference(q, k, v, True, scale).astype(jnp.float32) ** 2
+        )
+
+    g_bf16 = jax.grad(loss_flash, argnums=(0, 1, 2))(
+        jnp.asarray(qf, jnp.bfloat16), jnp.asarray(kf, jnp.bfloat16),
+        jnp.asarray(vf, jnp.bfloat16),
+    )
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(
+        jnp.asarray(qf), jnp.asarray(kf), jnp.asarray(vf)
+    )
+    for a, b_ in zip(g_bf16, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_), rtol=0.1, atol=0.5
+        )
