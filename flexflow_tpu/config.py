@@ -51,6 +51,10 @@ class FFConfig:
     enable_attribute_parallel: bool = False
     enable_inplace_optimizations: bool = False
     enable_control_replication: bool = True
+    # substitution search: explore GraphXfer-rewritten PCGs (inserting
+    # Repartition/Combine/Replicate/Reduction nodes) instead of only
+    # assigning configs on the fixed graph; implied by --substitution-json
+    enable_substitutions: bool = False
     # execution
     computation_mode: CompMode = CompMode.COMP_MODE_TRAINING
     profiling: bool = False
@@ -176,6 +180,8 @@ class FFConfig:
                 self.base_optimize_threshold = int(val())
             elif a == "--substitution-json":
                 self.substitution_json_path = val()
+            elif a == "--enable-substitutions":
+                self.enable_substitutions = True
             elif a == "--nodes":
                 self.num_nodes = int(val())
             elif a == "-ll:gpu" or a == "-ll:tpu" or a == "--workers-per-node":

@@ -45,10 +45,15 @@ def _attn_reference(q, k, v, causal: bool, scale: float):
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-    *, scale: float, causal: bool, block_q: int, block_k: int, seq_k: int,
-    causal_offset: int,
+    q_ref, k_ref, v_ref, o_ref, *refs,
+    scale: float, causal: bool, block_q: int, block_k: int, seq_k: int,
+    causal_offset: int, save_lse: bool,
 ):
+    if save_lse:
+        lse_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        lse_ref = None
+        m_ref, l_ref, acc_ref = refs
     i = pl.program_id(1)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
@@ -109,15 +114,18 @@ def _flash_kernel(
     @pl.when(j == nj - 1)
     def _finish():
         o_ref[0] = (acc_ref[...] / l_ref[...][:, None]).astype(o_ref.dtype)
-        # row stats carry a minor dim of LSE_LANES so the block is
-        # tile-legal on TPU (same trick as jax's in-tree flash kernel,
-        # which uses MIN_BLOCK_SIZE lanes)
-        lse = m_ref[...] + jnp.log(l_ref[...])
-        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref[0].shape)
+        if save_lse:
+            # row stats carry a minor dim of LSE_LANES so the block is
+            # tile-legal on TPU (same trick as jax's in-tree flash kernel,
+            # which uses MIN_BLOCK_SIZE lanes)
+            lse = m_ref[...] + jnp.log(l_ref[...])
+            lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref[0].shape)
 
 
 def _flash_fwd(q, k, v, causal: bool, scale: float,
-               block_q: int, block_k: int):
+               block_q: int, block_k: int, save_lse: bool = True):
+    """save_lse=False (the primal / inference path) skips computing and
+    writing the logsumexp residual entirely."""
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     bq = min(block_q, s_q)
@@ -128,9 +136,16 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
     grid = (b * h, pl.cdiv(s_q, bq), pl.cdiv(s_k, bk))
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        seq_k=s_k, causal_offset=s_k - s_q,
+        seq_k=s_k, causal_offset=s_k - s_q, save_lse=save_lse,
     )
-    out, lse = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype)]
+    if save_lse:
+        out_specs.append(
+            pl.BlockSpec((1, bq, LSE_LANES), lambda bh, i, j: (bh, i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, s_q, LSE_LANES), jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -138,14 +153,8 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq, LSE_LANES), lambda bh, i, j: (bh, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s_q, LSE_LANES), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bq,), jnp.float32),
             pltpu.VMEM((bq,), jnp.float32),
@@ -157,6 +166,10 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
         interpret=jax.default_backend() != "tpu",
         name="flash_attention_fwd",
     )(qf, kf, vf)
+    if save_lse:
+        out, lse = res
+    else:
+        (out,), lse = res, None
     return out.reshape(b, h, s_q, d), lse
 
 
@@ -365,7 +378,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, scale, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                        save_lse=False)
     return out
 
 

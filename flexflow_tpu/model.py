@@ -610,7 +610,24 @@ class FFModel:
 
         # --- mesh + strategy
         self.mesh = build_mesh(self.config.mesh_shape())
+        used_substitutions = False
         if (
+            self._strategy is None
+            and not self.config.only_data_parallel
+            and (self.config.enable_substitutions
+                 or self.config.substitution_json_path)
+        ):
+            # substitution half of Unity: explore GraphXfer-rewritten PCGs
+            # that insert explicit parallel ops (substitution.cc:1898+);
+            # the winning graph replaces the layer-built one and arrives
+            # with mesh axes + weight shardings already emitted
+            from .search.substitution import graph_optimize
+
+            tensor_to_out[self.layers[-1].outputs[0].tensor_guid][0]._is_logits = True
+            g = graph_optimize(g, self.mesh, self.config)
+            self.graph = g
+            used_substitutions = True
+        elif (
             self._strategy is None
             and not self.config.only_data_parallel
             and self.mesh.shape.get(AXIS_MODEL, 1) > 1
@@ -626,14 +643,29 @@ class FFModel:
             self._strategy = search_strategy(
                 g, self.mesh, self.config
             ).overrides
-        self._assign_strategy()
+        if not used_substitutions:
+            self._assign_strategy()
         if self.config.export_strategy_computation_graph_file:
             from .pcg.graph import export_dot
 
             export_dot(g, self.config.export_strategy_computation_graph_file)
 
-        # --- logits node = last layer's op
-        logits_node = tensor_to_out[self.layers[-1].outputs[0].tensor_guid][0]
+        # --- logits node = last layer's op (rewrites may have replaced it:
+        # the mapped output's producer is then the unique sink)
+        if used_substitutions:
+            marked = [n for n in g.topo_order()
+                      if getattr(n, "_is_logits", False)]
+            sinks = g.sinks()
+            if marked:
+                logits_node = marked[0]
+            elif len(sinks) == 1:
+                logits_node = sinks[0]
+            else:
+                raise RuntimeError(
+                    "cannot identify logits node after substitution rewrite")
+        else:
+            logits_node = tensor_to_out[
+                self.layers[-1].outputs[0].tensor_guid][0]
 
         # --- label sharding matches logits batch sharding (model.cc:3086-3124)
         label_spec = logits_node.outputs[0].partition_spec()
@@ -872,7 +904,4 @@ class FFModel:
                       f"out={[t.dims for t in l.outputs]}")
 
 
-def _is_expert_buffer(node: OpNode) -> bool:
-    """Expert-capacity buffers (outputs of group_by and expert branches) have
-    no batch dim; don't shard their dim 0 over data."""
-    return node.op_type in (OT.OP_GROUP_BY,)
+from .pcg.graph import is_expert_buffer as _is_expert_buffer  # noqa: E402

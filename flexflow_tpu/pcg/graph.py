@@ -149,6 +149,14 @@ class Graph:
         return len(self.nodes)
 
 
+def is_expert_buffer(node: OpNode) -> bool:
+    """Expert-capacity buffers (outputs of group_by and expert branches) have
+    no batch dim; the data-parallel fallback must not shard their dim 0.
+    Shared by the default strategy assignment (model._assign_strategy) and
+    the substitution path (search.substitution.assign_axes_from_degrees)."""
+    return node.op_type in (OperatorType.OP_GROUP_BY,)
+
+
 def export_dot(graph: "Graph", path: str | None = None) -> str:
     """DOT export of the PCG with placements (reference print_dot /
     export_strategy_computation_graph_file, utils/dot/*)."""

@@ -1,0 +1,823 @@
+"""GraphXfer substitution engine: PCG rewrites that insert/remove parallel ops.
+
+Reference: src/runtime/substitution.cc — TASO-style rewrite rules where a
+source pattern of `OpX` nodes (with `TensorX` symbolic tensors) is replaced by
+a target pattern, discovered by a backtracking matcher (`find_matches`, :510)
+and applied by graph reconstruction (`create_new_graph`, :782); ~30 hand-coded
+generators build the rule set (generate_all_pcg_xfers, :1726-1868) and a JSON
+loader adds external rules (substitution_loader.cc); `base_optimize`
+(:2229-2311) explores rewritten graphs best-first under a budget with alpha
+pruning and graph-hash dedup.
+
+TPU-native recast: rewrites operate on our PCG (pcg/graph.py) and insert
+explicit Repartition/Combine/Replicate/Reduction nodes
+(parallel/ops.apply_parallel_op_shape is the per-node shape transform). One
+deliberate divergence from the reference's mechanics: compute-op params stay
+GLOBAL after a rewrite (the reference rewrites attention to num_heads/k per
+device; under GSPMD the op keeps global heads and the sharding lives in the
+tensors' ParallelDim degrees + weight PartitionSpecs, which the executor pins
+— XLA then partitions the op). `propagate_parallel_state` is the
+solve_parallel_dim_mappings analog: it re-derives every tensor's degrees and
+every op's implied weight shardings from the inserted parallel ops.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import json
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Optional
+
+from jax.sharding import PartitionSpec
+
+from ..fftype import ActiMode, OperatorType as OT
+from ..machine import AXIS_DATA, AXIS_MODEL
+from ..parallel.ops import (
+    CombineParams,
+    ReductionParams,
+    RepartitionParams,
+    ReplicateParams,
+    apply_parallel_op_shape,
+)
+from ..pcg.graph import Graph, OpNode, is_expert_buffer
+from ..tensor import ParallelDim, ParallelTensor, ParallelTensorShape
+from .cost_model import CostModel, dtype_bytes
+
+# --------------------------------------------------------------------- pattern
+
+
+@dataclass(frozen=True)
+class TensorX:
+    """Symbolic tensor: output `idx` of pattern op `op`, or (op=None) the
+    xfer's free input slot `idx` (reference TensorX, substitution.h)."""
+
+    op: Optional["OpX"] = None
+    idx: int = 0
+
+
+class OpX:
+    """One pattern/replacement operator (reference OpX).
+
+    Source-side: `op_type` + `constraints` (predicates on the matched
+    OpNode) define what matches. Dest-side: `match_src` names the source OpX
+    whose params/name/weights the new node inherits (the reference's
+    matchOpX), or `make_params` builds fresh params (parallel ops)."""
+
+    def __init__(
+        self,
+        op_type: OT,
+        inputs: tuple[TensorX, ...] = (),
+        num_outputs: int = 1,
+        constraints: tuple[Callable[[OpNode], bool], ...] = (),
+        match_src: Optional["OpX"] = None,
+        make_params: Optional[Callable[[dict], Any]] = None,
+    ):
+        self.op_type = op_type
+        self.inputs = tuple(inputs)
+        self.outputs = tuple(TensorX(self, i) for i in range(num_outputs))
+        self.constraints = tuple(constraints)
+        self.match_src = match_src
+        self.make_params = make_params
+
+
+@dataclass
+class Match:
+    """One pattern occurrence: pattern op → graph node, free input slot →
+    (producer guid, out idx) — or (None, input-node guid) for graph sources."""
+
+    ops: dict  # OpX -> OpNode
+    inputs: dict  # slot idx -> (guid, out_idx)
+
+
+class GraphXfer:
+    """A rewrite rule: src pattern → dst pattern (reference GraphXfer)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.src_ops: list[OpX] = []
+        self.dst_ops: list[OpX] = []
+        # (src TensorX, dst TensorX): external consumers of the src tensor
+        # re-point to the dst tensor after the rewrite (map_output)
+        self.mapped_outputs: list[tuple[TensorX, TensorX]] = []
+
+    def new_input(self, idx: int) -> TensorX:
+        return TensorX(None, idx)
+
+    def map_output(self, src_tx: TensorX, dst_tx: TensorX):
+        self.mapped_outputs.append((src_tx, dst_tx))
+
+    # ------------------------------------------------------------- matching
+
+    def find_matches(self, graph: Graph) -> list[Match]:
+        """Backtracking pattern match (reference find_matches,
+        substitution.cc:510)."""
+        matches: list[Match] = []
+        order = graph.topo_order()
+        self._match_rec(graph, order, 0, Match({}, {}), matches)
+        return matches
+
+    def _match_rec(self, graph, order, depth, cur: Match, out: list[Match]):
+        if depth == len(self.src_ops):
+            if self._check_internal_consumers(graph, cur):
+                out.append(Match(dict(cur.ops), dict(cur.inputs)))
+            return
+        px = self.src_ops[depth]
+        for node in order:
+            if node.op_type != px.op_type or node in cur.ops.values():
+                continue
+            if not all(c(node) for c in px.constraints):
+                continue
+            edges = sorted(graph.in_edges[node.guid], key=lambda e: e.dst_idx)
+            if len(edges) < len(px.inputs):
+                continue
+            binding_inputs = dict(cur.inputs)
+            ok = True
+            for i, tx in enumerate(px.inputs):
+                e = edges[i]
+                src = (e.src, e.src_idx)
+                if tx.op is not None:  # must come from an earlier matched op
+                    want = cur.ops.get(tx.op)
+                    if want is None or want.guid != e.src or tx.idx != e.src_idx:
+                        ok = False
+                        break
+                else:  # free input slot: bind or check consistency
+                    bound = binding_inputs.get(tx.idx)
+                    if bound is None:
+                        binding_inputs[tx.idx] = src
+                    elif bound != src:
+                        ok = False
+                        break
+            if not ok:
+                continue
+            cur.ops[px] = node
+            saved = cur.inputs
+            cur.inputs = binding_inputs
+            self._match_rec(graph, order, depth + 1, cur, out)
+            cur.inputs = saved
+            del cur.ops[px]
+
+    def _check_internal_consumers(self, graph, m: Match) -> bool:
+        """Non-mapped outputs of matched ops must have no consumers outside
+        the match (else the rewrite would orphan them)."""
+        matched = {n.guid for n in m.ops.values()}
+        mapped = set()
+        for src_tx, _ in self.mapped_outputs:
+            node = m.ops[src_tx.op]
+            mapped.add((node.guid, src_tx.idx))
+        for px, node in m.ops.items():
+            for e in graph.out_edges[node.guid]:
+                if (node.guid, e.src_idx) in mapped:
+                    continue
+                if e.dst not in matched:
+                    return False
+        return True
+
+    # -------------------------------------------------------------- rewrite
+
+    def apply(self, graph: Graph, m: Match) -> Graph:
+        """Build the rewritten graph (reference create_new_graph,
+        substitution.cc:782). Raises ValueError when the rewritten parallel
+        state is inconsistent (invalid candidate — caller discards)."""
+        new_g = Graph()
+        matched = {n.guid for n in m.ops.values()}
+        clone: dict[int, OpNode] = {}
+        for node in graph.topo_order():
+            if node.guid in matched:
+                continue
+            clone[node.guid] = _clone_node(new_g, node)
+        # instantiate dst ops
+        dst_node: dict[OpX, OpNode] = {}
+        for dx in self.dst_ops:
+            if dx.match_src is not None:
+                src_node = m.ops[dx.match_src]
+                params = (dx.make_params(m.ops) if dx.make_params
+                          else src_node.params)
+                n = OpNode(dx.op_type, params, name=src_node.name,
+                           layer_guid=src_node.layer_guid,
+                           initializers=src_node.initializers)
+                n.weight_specs = list(src_node.weight_specs)
+            else:
+                params = dx.make_params(m.ops) if dx.make_params else None
+                n = OpNode(dx.op_type, params)
+            new_g.add_node(n)
+            dst_node[dx] = n
+
+        def resolve(tx: TensorX) -> tuple[OpNode, int]:
+            if tx.op is None:
+                guid, idx = m.inputs[tx.idx]
+                return clone[guid], idx
+            if tx.op in dst_node:
+                return dst_node[tx.op], tx.idx
+            raise ValueError(f"dangling TensorX in xfer {self.name}")
+
+        # wire dst-op inputs
+        for dx in self.dst_ops:
+            n = dst_node[dx]
+            for dst_idx, tx in enumerate(dx.inputs):
+                src_n, src_idx = resolve(tx)
+                new_g.add_edge(src_n, n, src_idx, dst_idx)
+        # wire edges among unmatched nodes + re-point mapped outputs
+        mapped = {}
+        for src_tx, dst_tx in self.mapped_outputs:
+            node = m.ops[src_tx.op]
+            mapped[(node.guid, src_tx.idx)] = resolve(dst_tx)
+        for node in graph.topo_order():
+            for e in graph.out_edges[node.guid]:
+                if e.dst in matched:
+                    continue
+                if node.guid in matched:
+                    src_n, src_idx = mapped[(e.src, e.src_idx)]
+                else:
+                    src_n, src_idx = clone[e.src], e.src_idx
+                new_g.add_edge(src_n, clone[e.dst], src_idx, e.dst_idx)
+        # carry the logits marker through the rewrite so compile can find
+        # the output node after arbitrary rewrites (FFModel sets it on the
+        # original sink before graph_optimize)
+        for node in graph.topo_order():
+            if not getattr(node, "_is_logits", False):
+                continue
+            if node.guid in matched:
+                nn = mapped.get((node.guid, 0), (None, 0))[0]
+            else:
+                nn = clone[node.guid]
+            if nn is not None:
+                nn._is_logits = True
+        propagate_parallel_state(new_g)
+        return new_g
+
+
+def _clone_node(g: Graph, node: OpNode) -> OpNode:
+    n = OpNode(node.op_type, node.params, name=node.name,
+               layer_guid=node.layer_guid, initializers=node.initializers)
+    n.weight_specs = list(node.weight_specs)
+    n.weight_axes = dict(node.weight_axes)
+    if node.op_type == OT.OP_INPUT:
+        # input nodes keep their ParallelTensor shape (degree-1 source)
+        n.outputs = [ParallelTensor(pt.shape, name=pt.name)
+                     for pt in node.outputs]
+    g.add_node(n)
+    return n
+
+
+# ------------------------------------------------- parallel-state propagation
+
+_PASSTHROUGH = frozenset({
+    OT.OP_RELU, OT.OP_GELU, OT.OP_SIGMOID, OT.OP_TANH, OT.OP_ELU,
+    OT.OP_IDENTITY, OT.OP_DROPOUT, OT.OP_SCALAR_MULTIPLY, OT.OP_SCALAR_ADD,
+    OT.OP_SCALAR_SUB, OT.OP_SCALAR_TRUE_DIV, OT.OP_EXP, OT.OP_SIN, OT.OP_COS,
+    OT.OP_RSQRT, OT.OP_POW, OT.OP_LAYERNORM, OT.OP_SOFTMAX, OT.OP_CAST,
+})
+
+_PARALLEL = frozenset({
+    OT.OP_REPARTITION, OT.OP_COMBINE, OT.OP_REPLICATE, OT.OP_REDUCTION,
+    OT.OP_FUSED_PARALLEL, OT.OP_PIPELINE,
+})
+
+
+def propagate_parallel_state(graph: Graph):
+    """Re-derive every tensor's ParallelDim degrees and every compute op's
+    implied weight shardings from the graph's explicit parallel ops — the
+    solve_parallel_dim_mappings analog (reference operator.cc /
+    ParallelDimMappingRecord). Raises ValueError on inconsistent state."""
+    for node in graph.topo_order():
+        if node.op_type == OT.OP_INPUT:
+            if not node.outputs:
+                raise ValueError(f"input node {node.name} has no tensor")
+            node.inputs = []
+            continue
+        in_pts: list[ParallelTensor] = []
+        for e in sorted(graph.in_edges[node.guid], key=lambda e: e.dst_idx):
+            in_pts.append(graph.nodes[e.src].outputs[e.src_idx])
+        node.inputs = in_pts
+        in_shapes = [pt.shape for pt in in_pts]
+        weight_partition: dict[str, tuple[int, int]] = {}
+
+        if node.op_type in _PARALLEL:
+            out_shapes = [apply_parallel_op_shape(
+                in_shapes[0], node.op_type, node.params)]
+        elif node.op_type == OT.OP_LINEAR:
+            out_shapes = [_linear_parallel(node, in_shapes[0],
+                                           weight_partition)]
+        elif node.op_type == OT.OP_MULTIHEAD_ATTENTION:
+            out_shapes = [_attention_parallel(node, in_shapes,
+                                              weight_partition)]
+        elif node.op_type in _PASSTHROUGH:
+            out_shapes = [in_shapes[0]]
+        elif node.op_type in (OT.OP_EW_ADD, OT.OP_EW_SUB, OT.OP_EW_MUL,
+                              OT.OP_EW_DIV, OT.OP_EW_MAX, OT.OP_EW_MIN):
+            if in_shapes[0].dims != in_shapes[1].dims:
+                raise ValueError(
+                    f"{node.name}: element-binary operands have different "
+                    f"parallel shapes {in_shapes[0]} vs {in_shapes[1]}")
+            out_shapes = [in_shapes[0]]
+        else:
+            # generic op: forbid replica dims, propagate positional degrees
+            # where the op's inferred output rank matches the input rank,
+            # else require unsharded inputs
+            for s in in_shapes:
+                if s.num_replica_dims:
+                    raise ValueError(
+                        f"{node.name} ({node.op_type.name}) cannot consume a "
+                        f"replicated tensor")
+            logical_in = [s.logical_shape for s in in_shapes]
+            inferred = node.op_def.infer_shapes(node.params, logical_in)
+            out_shapes = []
+            for shp in inferred:
+                if (in_shapes and len(shp) == len(logical_in[0])
+                        and all(d.degree == 1
+                                for d in in_shapes[0].dims[1:])):
+                    dims = [ParallelDim(shp[0],
+                                        in_shapes[0].dims[0].degree)]
+                    dims += [ParallelDim(s) for s in shp[1:]]
+                elif all(d.degree == 1 for s in in_shapes for d in s.dims):
+                    dims = [ParallelDim(s) for s in shp]
+                else:
+                    raise ValueError(
+                        f"{node.name} ({node.op_type.name}): unsupported "
+                        f"parallel inputs {in_shapes}")
+                out_shapes.append(
+                    ParallelTensorShape(tuple(dims), in_shapes[0].dtype))
+
+        old = node.outputs
+        node.outputs = []
+        for i, shape in enumerate(out_shapes):
+            name = old[i].name if i < len(old) else f"{node.name}_out{i}"
+            pt = ParallelTensor(shape, name=name)
+            pt.owner_op, pt.owner_idx = node, i
+            node.outputs.append(pt)
+        node._weight_partition = weight_partition
+
+
+def _linear_parallel(node, in_shape: ParallelTensorShape, wp: dict):
+    """Linear under parallel input state (reference linear.cc dim mappings):
+    - batch-dim degrees propagate;
+    - input replica dim (degree r) → kernel out-dim sharded r, output
+      feature dim sharded r, replica dim consumed  [column TP];
+    - input feature dim sharded (degree c) → kernel in-dim sharded c, output
+      gains a replica dim of degree c (partial sums)  [row TP]."""
+    dims = in_shape.dims
+    logical = [d for d in dims if not d.is_replica_dim]
+    replicas = [d for d in dims if d.is_replica_dim]
+    if len(replicas) > 1:
+        raise ValueError(f"{node.name}: multiple replica dims unsupported")
+    r = replicas[0].degree if replicas else 1
+    feat_deg = logical[-1].degree
+    if r > 1 and feat_deg > 1:
+        raise ValueError(
+            f"{node.name}: simultaneous replicate + feature partition "
+            f"unsupported")
+    out_ch = node.params.out_channels
+    out_dims = [replace(d) for d in logical[:-1]]
+    if r > 1:
+        if out_ch % r != 0:
+            raise ValueError(f"{node.name}: out_channels {out_ch} % {r} != 0")
+        out_dims.append(ParallelDim(out_ch, r))
+        wp["kernel"] = (1, r)
+        if node.params.use_bias:
+            wp["bias"] = (0, r)
+    else:
+        out_dims.append(ParallelDim(out_ch))
+    if feat_deg > 1:
+        wp["kernel"] = (0, feat_deg)
+        out_dims.append(ParallelDim(feat_deg, feat_deg,
+                                    is_replica_dim=True))
+    return ParallelTensorShape(tuple(out_dims), in_shape.dtype)
+
+
+def _attention_parallel(node, in_shapes, wp: dict):
+    """MHA under replicated input (reference replicate_attention_reduce):
+    input replica degree r → q/k/v projections sharded on heads (out dim),
+    out-projection row-sharded, output gains a replica dim of degree r
+    (partial sums consumed by a Reduction node)."""
+    q = in_shapes[0]
+    replicas = [d for d in q.dims if d.is_replica_dim]
+    r = replicas[0].degree if replicas else 1
+    logical = [d for d in q.dims if not d.is_replica_dim]
+    if any(d.degree > 1 for d in logical[1:]):
+        raise ValueError(f"{node.name}: feature-sharded attention input "
+                         f"unsupported")
+    out_dims = [replace(d) for d in logical[:-1]]
+    out_dims.append(ParallelDim(node.params.embed_dim))
+    if r > 1:
+        if node.params.num_heads % r != 0:
+            raise ValueError(
+                f"{node.name}: num_heads {node.params.num_heads} % {r} != 0")
+        for w in ("wq", "wk", "wv"):
+            wp[w] = (1, r)
+        for b in ("bq", "bk", "bv"):
+            wp[b] = (0, r)
+        wp["wo"] = (0, r)
+        out_dims.append(ParallelDim(r, r, is_replica_dim=True))
+    return ParallelTensorShape(tuple(out_dims), q.dtype)
+
+
+# ---------------------------------------------------------- axis assignment
+
+def assign_axes_from_degrees(graph: Graph, mesh):
+    """Map every tensor's ParallelDim degrees to mesh axes and emit weight
+    PartitionSpecs — the FFMapper analog for rewritten graphs. Batch (dim-0)
+    degrees ride the `data` axis; feature/replica/reduction degrees ride
+    `model`. Unsharded tensors get the default data-parallel batch sharding
+    (graph.cc:1939-1964 fallback)."""
+    sizes = dict(mesh.shape)
+    data_deg = sizes.get(AXIS_DATA, 1)
+    model_deg = sizes.get(AXIS_MODEL, 1)
+
+    def axis_for(dim_idx: int, degree: int) -> str:
+        if dim_idx == 0 and degree == data_deg:
+            return AXIS_DATA
+        if degree == model_deg:
+            return AXIS_MODEL
+        if degree == data_deg:
+            return AXIS_DATA
+        raise ValueError(
+            f"degree {degree} matches no mesh axis in {sizes}")
+
+    for node in graph.topo_order():
+        for pt in node.outputs:
+            assignment = []
+            logical_idx = 0
+            for d in pt.shape.dims:
+                if d.is_replica_dim:
+                    assignment.append(())
+                    continue
+                if d.degree > 1:
+                    assignment.append((axis_for(logical_idx, d.degree),))
+                elif (logical_idx == 0 and data_deg > 1
+                      and d.size % data_deg == 0
+                      and not is_expert_buffer(node)):
+                    # default data-parallel batch sharding composes with the
+                    # rewrite-derived feature/replica shardings (dp x tp)
+                    assignment.append((AXIS_DATA,))
+                else:
+                    assignment.append(())
+                logical_idx += 1
+            pt.assign_axes(tuple(assignment))
+        wp = getattr(node, "_weight_partition", None)
+        if wp:
+            for wname, (dim_idx, degree) in wp.items():
+                ws = next((w for w in node.weight_specs if w.name == wname),
+                          None)
+                if ws is None:
+                    continue
+                entries = [None] * len(ws.shape)
+                entries[dim_idx] = axis_for(-1, degree)
+                node.weight_axes[wname] = PartitionSpec(*entries)
+
+
+# ------------------------------------------------------------- graph costing
+
+def evaluate_graph(graph: Graph, mesh, cm: CostModel) -> tuple[float, float]:
+    """(time, per-chip memory) of a rewritten PCG: compute ops through the
+    cost model on their emitted assignments; parallel ops priced as the
+    collectives they lower to (the reference prices them as partition-copy
+    tasks via the simulator)."""
+    assign_axes_from_degrees(graph, mesh)
+    total, mem = 0.0, 0.0
+    machine = cm.machine
+    for node in graph.topo_order():
+        if node.op_type in (OT.OP_INPUT, OT.OP_WEIGHT, OT.OP_NOOP):
+            continue
+        if node.op_type in _PARALLEL:
+            pt = node.inputs[0]
+            local_bytes = (pt.shape.piece_elements()
+                           * dtype_bytes(pt.dtype))
+            if node.op_type == OT.OP_COMBINE:
+                ax = _degree_axis(machine, node.params.degree)
+                total += machine.all_gather(
+                    local_bytes * node.params.degree, ax)
+            elif node.op_type == OT.OP_REPARTITION:
+                if pt.shape.total_degree > 1:
+                    ax = _degree_axis(machine, node.params.degree)
+                    total += machine.all_to_all(local_bytes, ax)
+                # from fully-replicated: local slice, free
+            elif node.op_type == OT.OP_REDUCTION:
+                ax = _degree_axis(machine, node.params.degree)
+                total += machine.all_reduce(local_bytes, ax)
+            # Replicate: broadcast of an already-replicated tensor — free
+            continue
+        in_shapes, in_assigns = [], []
+        for pt in node.inputs:
+            in_shapes.append(pt.shape.logical_shape)
+            in_assigns.append(_logical_assignment(pt))
+        cmx = cm.op_cost(
+            node, [_logical_assignment(pt) for pt in node.outputs],
+            dict(node.weight_axes), in_shapes, in_assigns)
+        total += cmx.total
+        mem += cmx.memory
+    return total, mem
+
+
+def _logical_assignment(pt: ParallelTensor):
+    return tuple(a for d, a in zip(pt.shape.dims, pt.axis_assignment)
+                 if not d.is_replica_dim)
+
+
+def _degree_axis(machine, degree: int) -> str:
+    for ax, size in machine.axis_sizes.items():
+        if size == degree:
+            return ax
+    return AXIS_MODEL
+
+
+# ------------------------------------------------------------ rule generators
+
+def _lin_act(act):
+    return lambda n: n.params.activation == act
+
+
+def create_partition_linear_combine(degree: int, activation) -> GraphXfer:
+    """Repartition(sample) → Linear → Combine(sample)
+    (substitution.cc:3041)."""
+    x = GraphXfer(f"partition_linear_combine[deg={degree},act={activation}]")
+    inp = x.new_input(0)
+    lin1 = OpX(OT.OP_LINEAR, (inp,), constraints=(_lin_act(activation),))
+    rep = OpX(OT.OP_REPARTITION, (inp,),
+              make_params=lambda m: RepartitionParams(0, degree))
+    lin2 = OpX(OT.OP_LINEAR, (rep.outputs[0],), match_src=lin1)
+    comb = OpX(OT.OP_COMBINE, (lin2.outputs[0],),
+               make_params=lambda m: CombineParams(0, degree))
+    x.src_ops = [lin1]
+    x.dst_ops = [rep, lin2, comb]
+    x.map_output(lin1.outputs[0], comb.outputs[0])
+    return x
+
+
+def create_replicate_linear_combine(degree: int, activation) -> GraphXfer:
+    """Replicate → Linear(kernel out-dim sharded) → Combine(feature): column
+    tensor parallelism (substitution.cc:3226)."""
+    x = GraphXfer(f"replicate_linear_combine[deg={degree},act={activation}]")
+    inp = x.new_input(0)
+    lin1 = OpX(OT.OP_LINEAR, (inp,), constraints=(_lin_act(activation),))
+    repl = OpX(OT.OP_REPLICATE, (inp,),
+               make_params=lambda m: ReplicateParams(degree))
+    lin2 = OpX(OT.OP_LINEAR, (repl.outputs[0],), match_src=lin1)
+
+    def combine_feature(m):
+        lin = m[lin1]
+        ndim = len(lin.outputs[0].shape.logical_shape)
+        return CombineParams(ndim - 1, degree)
+
+    comb = OpX(OT.OP_COMBINE, (lin2.outputs[0],),
+               make_params=combine_feature)
+    x.src_ops = [lin1]
+    x.dst_ops = [repl, lin2, comb]
+    x.map_output(lin1.outputs[0], comb.outputs[0])
+    return x
+
+
+def create_replicate_attention_reduce(degree: int) -> GraphXfer:
+    """Replicate → MHA(heads sharded, row-parallel out-proj) → Reduction:
+    inserts an explicit Reduction node consuming the partial-sum replica dim
+    (substitution.cc create_replicate_attention_reduce)."""
+    x = GraphXfer(f"replicate_attention_reduce[deg={degree}]")
+    inp = x.new_input(0)
+    attn1 = OpX(
+        OT.OP_MULTIHEAD_ATTENTION, (inp, inp, inp),
+        constraints=(lambda n: n.params.num_heads % degree == 0,),
+    )
+    repl = OpX(OT.OP_REPLICATE, (inp,),
+               make_params=lambda m: ReplicateParams(degree))
+    r0 = repl.outputs[0]
+    attn2 = OpX(OT.OP_MULTIHEAD_ATTENTION, (r0, r0, r0), match_src=attn1)
+    red = OpX(OT.OP_REDUCTION, (attn2.outputs[0],),
+              make_params=lambda m: ReductionParams(degree))
+    x.src_ops = [attn1]
+    x.dst_ops = [repl, attn2, red]
+    x.map_output(attn1.outputs[0], red.outputs[0])
+    return x
+
+
+def create_partition_attention_combine(degree: int) -> GraphXfer:
+    """Repartition(sample) → MHA → Combine(sample)
+    (substitution.cc create_partition_attention_combine)."""
+    x = GraphXfer(f"partition_attention_combine[deg={degree}]")
+    inp = x.new_input(0)
+    attn1 = OpX(OT.OP_MULTIHEAD_ATTENTION, (inp, inp, inp))
+    rep = OpX(OT.OP_REPARTITION, (inp,),
+              make_params=lambda m: RepartitionParams(0, degree))
+    r0 = rep.outputs[0]
+    attn2 = OpX(OT.OP_MULTIHEAD_ATTENTION, (r0, r0, r0), match_src=attn1)
+    comb = OpX(OT.OP_COMBINE, (attn2.outputs[0],),
+               make_params=lambda m: CombineParams(0, degree))
+    x.src_ops = [attn1]
+    x.dst_ops = [rep, attn2, comb]
+    x.map_output(attn1.outputs[0], comb.outputs[0])
+    return x
+
+
+def create_partition_add_combine(degree: int) -> GraphXfer:
+    """Repartition both addends on sample, add, Combine back
+    (substitution.cc:3257)."""
+    x = GraphXfer(f"partition_add_combine[deg={degree}]")
+    a, b = x.new_input(0), x.new_input(1)
+    add1 = OpX(OT.OP_EW_ADD, (a, b))
+    rep1 = OpX(OT.OP_REPARTITION, (a,),
+               make_params=lambda m: RepartitionParams(0, degree))
+    rep2 = OpX(OT.OP_REPARTITION, (b,),
+               make_params=lambda m: RepartitionParams(0, degree))
+    add2 = OpX(OT.OP_EW_ADD, (rep1.outputs[0], rep2.outputs[0]))
+    comb = OpX(OT.OP_COMBINE, (add2.outputs[0],),
+               make_params=lambda m: CombineParams(0, degree))
+    x.src_ops = [add1]
+    x.dst_ops = [rep1, rep2, add2, comb]
+    x.map_output(add1.outputs[0], comb.outputs[0])
+    return x
+
+
+def _passthrough_partition(op_type: OT, degree: int, tag: str) -> GraphXfer:
+    x = GraphXfer(f"partition_{tag}_combine[deg={degree}]")
+    inp = x.new_input(0)
+    op1 = OpX(op_type, (inp,))
+    rep = OpX(OT.OP_REPARTITION, (inp,),
+              make_params=lambda m: RepartitionParams(0, degree))
+    op2 = OpX(op_type, (rep.outputs[0],), match_src=op1)
+    comb = OpX(OT.OP_COMBINE, (op2.outputs[0],),
+               make_params=lambda m: CombineParams(0, degree))
+    x.src_ops = [op1]
+    x.dst_ops = [rep, op2, comb]
+    x.map_output(op1.outputs[0], comb.outputs[0])
+    return x
+
+
+def create_partition_relu_combine(degree: int) -> GraphXfer:
+    return _passthrough_partition(OT.OP_RELU, degree, "relu")
+
+
+def create_partition_softmax_combine(degree: int) -> GraphXfer:
+    return _passthrough_partition(OT.OP_SOFTMAX, degree, "softmax")
+
+
+def create_linear_relu_merge() -> GraphXfer:
+    """Fuse Linear(no act) + ReLU into Linear(relu) — the algebraic (non-
+    parallel) substitution family (substitution.cc create_linear_relu_merge).
+    """
+    x = GraphXfer("linear_relu_merge")
+    inp = x.new_input(0)
+    lin = OpX(OT.OP_LINEAR, (inp,),
+              constraints=(_lin_act(ActiMode.AC_MODE_NONE),))
+    relu = OpX(OT.OP_RELU, (lin.outputs[0],))
+
+    def fused_params(m):
+        return replace(m[lin].params, activation=ActiMode.AC_MODE_RELU)
+
+    fused = OpX(OT.OP_LINEAR, (inp,), match_src=lin,
+                make_params=fused_params)
+    x.src_ops = [lin, relu]
+    x.dst_ops = [fused]
+    x.map_output(relu.outputs[0], fused.outputs[0])
+    return x
+
+
+_GENERATORS = {
+    "partition_linear_combine":
+        lambda deg, **kw: create_partition_linear_combine(
+            deg, kw.get("activation", ActiMode.AC_MODE_NONE)),
+    "replicate_linear_combine":
+        lambda deg, **kw: create_replicate_linear_combine(
+            deg, kw.get("activation", ActiMode.AC_MODE_NONE)),
+    "replicate_attention_reduce":
+        lambda deg, **kw: create_replicate_attention_reduce(deg),
+    "partition_attention_combine":
+        lambda deg, **kw: create_partition_attention_combine(deg),
+    "partition_add_combine":
+        lambda deg, **kw: create_partition_add_combine(deg),
+    "partition_relu_combine":
+        lambda deg, **kw: create_partition_relu_combine(deg),
+    "partition_softmax_combine":
+        lambda deg, **kw: create_partition_softmax_combine(deg),
+    "linear_relu_merge": lambda deg, **kw: create_linear_relu_merge(),
+}
+
+
+def generate_all_pcg_xfers(mesh, config) -> list[GraphXfer]:
+    """The rule set for a mesh (generate_all_pcg_xfers,
+    substitution.cc:1726): one instance of each family per usable parallel
+    degree (mesh axis sizes play the role of workersPerNode divisors)."""
+    xfers: list[GraphXfer] = [create_linear_relu_merge()]
+    sizes = dict(mesh.shape)
+    model_deg = sizes.get(AXIS_MODEL, 1)
+    data_deg = sizes.get(AXIS_DATA, 1)
+    acts = (ActiMode.AC_MODE_NONE, ActiMode.AC_MODE_RELU,
+            ActiMode.AC_MODE_SIGMOID, ActiMode.AC_MODE_GELU)
+    if model_deg > 1:
+        for act in acts:
+            xfers.append(create_replicate_linear_combine(model_deg, act))
+        xfers.append(create_replicate_attention_reduce(model_deg))
+    if data_deg > 1:
+        for act in acts:
+            xfers.append(create_partition_linear_combine(data_deg, act))
+        xfers.append(create_partition_attention_combine(data_deg))
+        xfers.append(create_partition_add_combine(data_deg))
+        xfers.append(create_partition_relu_combine(data_deg))
+        xfers.append(create_partition_softmax_combine(data_deg))
+    return xfers
+
+
+def load_rule_collection(path: str, mesh) -> list[GraphXfer]:
+    """JSON rule loader wired to --substitution-json (reference
+    substitution_loader.cc). Format:
+      {"rules": [{"generator": "replicate_linear_combine",
+                  "degree": 4, "activation": "relu"}, ...]}
+    `degree` defaults to the mesh's model-axis size. Unknown generators
+    raise (matching the reference loader's strictness)."""
+    with open(path) as f:
+        data = json.load(f)
+    sizes = dict(mesh.shape)
+    default_deg = sizes.get(AXIS_MODEL, 1)
+    acts = {"none": ActiMode.AC_MODE_NONE, "relu": ActiMode.AC_MODE_RELU,
+            "sigmoid": ActiMode.AC_MODE_SIGMOID,
+            "gelu": ActiMode.AC_MODE_GELU, "tanh": ActiMode.AC_MODE_TANH}
+    xfers = []
+    for rule in data.get("rules", []):
+        gen = rule.get("generator")
+        if gen not in _GENERATORS:
+            raise ValueError(
+                f"unknown substitution generator {gen!r}; have "
+                f"{sorted(_GENERATORS)}")
+        kw = {}
+        if "activation" in rule:
+            act = rule["activation"].strip().lower()
+            if act not in acts:
+                raise ValueError(
+                    f"unknown activation {rule['activation']!r}; have "
+                    f"{sorted(acts)}")
+            kw["activation"] = acts[act]
+        xfers.append(_GENERATORS[gen](int(rule.get("degree", default_deg)),
+                                      **kw))
+    return xfers
+
+
+# -------------------------------------------------------------- base_optimize
+
+def base_optimize(
+    graph: Graph,
+    mesh,
+    cm: CostModel,
+    xfers: list[GraphXfer],
+    budget: int = 16,
+    alpha: float = 1.2,
+    hbm_cap: Optional[float] = None,
+) -> tuple[Graph, float]:
+    """Best-first search over rewritten graphs (reference base_optimize,
+    substitution.cc:2229-2311): a candidate priority queue ordered by cost,
+    budgeted pops, alpha pruning against the incumbent, graph-hash dedup,
+    and per-chip HBM validity (graph.cc is_valid_strategy)."""
+
+    def cost_of(g: Graph) -> float:
+        t, mem = evaluate_graph(g, mesh, cm)
+        cap = hbm_cap if hbm_cap is not None else cm.machine.chip.hbm_bytes
+        if mem > cap:
+            t *= 1.0 + 10.0 * (mem - cap) / cap
+        return t
+
+    counter = itertools.count()
+    best_g, best_cost = graph, cost_of(graph)
+    pq: list = [(best_cost, next(counter), graph)]
+    seen = {graph.hash()}
+    pops = 0
+    while pq and pops < budget:
+        cost, _, g = heapq.heappop(pq)
+        pops += 1
+        if cost > best_cost * alpha:
+            continue
+        for xfer in xfers:
+            for m in xfer.find_matches(g):
+                try:
+                    ng = xfer.apply(g, m)
+                except ValueError:
+                    continue
+                h = ng.hash()
+                if h in seen:
+                    continue
+                seen.add(h)
+                try:
+                    nc = cost_of(ng)
+                except ValueError:
+                    continue
+                if nc < best_cost:
+                    best_g, best_cost = ng, nc
+                if nc < best_cost * alpha:
+                    heapq.heappush(pq, (nc, next(counter), ng))
+    assign_axes_from_degrees(best_g, mesh)
+    return best_g, best_cost
+
+
+def graph_optimize(graph: Graph, mesh, config,
+                   cm: Optional[CostModel] = None) -> Graph:
+    """Substitution-search entry (GraphSearchHelper::graph_optimize,
+    substitution.cc:1898): build the rule set (JSON rules when
+    --substitution-json is given, built-in generators otherwise), run
+    base_optimize, return the best rewritten graph with axes assigned."""
+    from .machine_model import machine_model_for_mesh
+
+    cm = cm or CostModel(machine_model_for_mesh(mesh))
+    if config.substitution_json_path:
+        xfers = load_rule_collection(config.substitution_json_path, mesh)
+    else:
+        xfers = generate_all_pcg_xfers(mesh, config)
+    budget = config.search_budget or 16
+    best, _ = base_optimize(graph, mesh, cm, xfers, budget=budget,
+                            alpha=config.search_alpha)
+    return best

@@ -1,0 +1,228 @@
+"""Substitution engine tests (reference: substitution.cc GraphXfer).
+
+Covers: the backtracking matcher, algebraic merge (linear+relu), parallel-op
+insertion (replicate_linear_combine, replicate_attention_reduce — the latter
+inserts an explicit Reduction node the config-only search cannot express),
+base_optimize best-first search, JSON rule loading, and end-to-end numerics
+of rewritten graphs against the unrewritten baseline.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    SGDOptimizer,
+)
+from flexflow_tpu.fftype import OperatorType as OT
+
+
+def _mk_config(argv=()):
+    import sys
+
+    old = sys.argv
+    sys.argv = ["t", *argv]
+    try:
+        return FFConfig()
+    finally:
+        sys.argv = old
+
+
+def _mlp(config, prefix="m"):
+    ff = FFModel(config)
+    x = ff.create_tensor((config.batch_size, 32), name=f"{prefix}_in")
+    t = ff.dense(x, 64, name=f"{prefix}_fc1")
+    t = ff.relu(t, name=f"{prefix}_relu")
+    t = ff.dense(t, 10, name=f"{prefix}_fc2")
+    return ff, x
+
+
+def _attn_model(config, prefix="a"):
+    ff = FFModel(config)
+    x = ff.create_tensor((config.batch_size, 16, 32), name=f"{prefix}_in")
+    t = ff.multihead_attention(x, x, x, 32, 4, name=f"{prefix}_attn")
+    t = ff.dense(t, 10, name=f"{prefix}_head")
+    return ff, x
+
+
+def test_matcher_finds_all_linears():
+    from flexflow_tpu.search.substitution import (
+        create_partition_linear_combine,
+    )
+
+    config = _mk_config(["-b", "8"])
+    ff, _ = _mlp(config)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    xfer = create_partition_linear_combine(2, ActiMode.AC_MODE_NONE)
+    matches = xfer.find_matches(ff.graph)
+    # both dense layers have AC_MODE_NONE activation
+    assert len(matches) == 2
+
+
+def test_linear_relu_merge_numerics():
+    from flexflow_tpu.search.substitution import (
+        create_linear_relu_merge,
+        propagate_parallel_state,
+    )
+
+    config = _mk_config(["-b", "8", "--mesh", "1,1,1,1"])
+    ff, _ = _mlp(config, prefix="lrm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    xfer = create_linear_relu_merge()
+    matches = xfer.find_matches(ff.graph)
+    assert len(matches) == 1
+    ng = xfer.apply(ff.graph, matches[0])
+    # relu node folded away
+    assert len(ng) == len(ff.graph) - 1
+    types = {n.op_type for n in ng.topo_order()}
+    assert OT.OP_RELU not in types
+    fc1 = next(n for n in ng.topo_order() if n.name == "lrm_fc1")
+    assert fc1.params.activation == ActiMode.AC_MODE_RELU
+
+
+def test_replicate_attention_reduce_inserts_reduction():
+    """The flagship rewrite: an explicit Reduction node appears — something
+    the config-only UnitySearch cannot express (VERDICT item 3)."""
+    from flexflow_tpu.search.substitution import (
+        create_replicate_attention_reduce,
+    )
+
+    config = _mk_config(["-b", "8", "--mesh", "2,2,1,1"])
+    ff, _ = _attn_model(config)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    xfer = create_replicate_attention_reduce(2)
+    matches = xfer.find_matches(ff.graph)
+    assert len(matches) == 1
+    ng = xfer.apply(ff.graph, matches[0])
+    types = [n.op_type for n in ng.topo_order()]
+    assert OT.OP_REDUCTION in types
+    assert OT.OP_REPLICATE in types
+    attn = next(n for n in ng.topo_order()
+                if n.op_type == OT.OP_MULTIHEAD_ATTENTION)
+    # weight shardings implied by the rewrite (column q/k/v, row out-proj)
+    assert attn._weight_partition["wq"] == (1, 2)
+    assert attn._weight_partition["wo"] == (0, 2)
+    # attention output carries the partial-sum replica dim; the Reduction
+    # node consumes it
+    assert attn.outputs[0].shape.num_replica_dims == 1
+    red = next(n for n in ng.topo_order() if n.op_type == OT.OP_REDUCTION)
+    assert red.outputs[0].shape.num_replica_dims == 0
+
+
+def test_rewritten_graph_numerics_match_baseline():
+    """Executing the substitution-rewritten model reproduces the baseline
+    model's logits (same seed, same layer names → same weights)."""
+    rs = np.random.RandomState(0)
+    x_np = rs.randn(8, 16, 32).astype(np.float32)
+
+    config_a = _mk_config(["-b", "8", "--mesh", "2,2,1,1"])
+    ff_a, _ = _attn_model(config_a)
+    ff_a.compile(optimizer=SGDOptimizer(lr=0.1),
+                 loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    logits_a, _ = ff_a.executor.build_forward()(
+        ff_a._params, ff_a._state, {"a_in": x_np}, False)
+
+    config_b = _mk_config(["-b", "8", "--mesh", "2,2,1,1",
+                           "--enable-substitutions", "--budget", "8"])
+    ff_b, _ = _attn_model(config_b)
+    ff_b.compile(optimizer=SGDOptimizer(lr=0.1),
+                 loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    logits_b, _ = ff_b.executor.build_forward()(
+        ff_b._params, ff_b._state, {"a_in": x_np}, False)
+    np.testing.assert_allclose(np.asarray(logits_a), np.asarray(logits_b),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_base_optimize_improves_or_keeps_cost():
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.machine_model import machine_model_for_mesh
+    from flexflow_tpu.search.substitution import (
+        base_optimize,
+        evaluate_graph,
+        generate_all_pcg_xfers,
+    )
+
+    config = _mk_config(["-b", "8", "--mesh", "2,2,1,1"])
+    ff, _ = _mlp(config, prefix="bo")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    cm = CostModel(machine_model_for_mesh(ff.mesh))
+    t0, _ = evaluate_graph(ff.graph, ff.mesh, cm)
+    xfers = generate_all_pcg_xfers(ff.mesh, config)
+    best, cost = base_optimize(ff.graph, ff.mesh, cm, xfers, budget=8)
+    assert cost <= t0 * 1.0001
+
+
+def test_substitution_json_loader(tmp_path):
+    from flexflow_tpu.search.substitution import load_rule_collection
+
+    config = _mk_config(["-b", "8", "--mesh", "2,2,1,1"])
+    ff, _ = _mlp(config, prefix="jl")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rules = {"rules": [
+        {"generator": "replicate_linear_combine", "degree": 2,
+         "activation": "none"},
+        {"generator": "linear_relu_merge"},
+    ]}
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps(rules))
+    xfers = load_rule_collection(str(p), ff.mesh)
+    assert len(xfers) == 2
+    with pytest.raises(ValueError):
+        p2 = tmp_path / "bad.json"
+        p2.write_text(json.dumps({"rules": [{"generator": "nope"}]}))
+        load_rule_collection(str(p2), ff.mesh)
+
+
+def test_substitution_json_end_to_end(tmp_path):
+    """--substitution-json drives compile through the rewrite search and the
+    model still trains (the flag is no longer decorative)."""
+    rules = {"rules": [
+        {"generator": "replicate_linear_combine", "activation": "none"},
+        {"generator": "linear_relu_merge"},
+    ]}
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps(rules))
+    config = _mk_config(["-b", "16", "--mesh", "2,2,1,1",
+                         "--substitution-json", str(p), "--budget", "6"])
+    ff = FFModel(config)
+    x = ff.create_tensor((16, 32), name="sj_in")
+    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="sj_fc1")
+    t = ff.softmax(ff.dense(t, 8, name="sj_fc2"), name="sj_sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rs = np.random.RandomState(0)
+    c = rs.randn(8, 32) * 3
+    y = rs.randint(0, 8, 256)
+    xs = (c[y] + rs.randn(256, 32)).astype(np.float32)
+    ff.fit(xs, y.reshape(-1, 1).astype(np.int32), epochs=2)
+    assert ff.get_perf_metrics().train_all > 0
+
+
+def test_partition_add_combine_shapes():
+    from flexflow_tpu.search.substitution import create_partition_add_combine
+
+    config = _mk_config(["-b", "8", "--mesh", "2,1,1,1"])
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 32), name="pa_in")
+    a = ff.dense(x, 32, name="pa_fc1")
+    b = ff.dense(x, 32, name="pa_fc2")
+    t = ff.add(a, b, name="pa_add")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_IDENTITY)
+    xfer = create_partition_add_combine(2)
+    matches = xfer.find_matches(ff.graph)
+    assert len(matches) == 1
+    ng = xfer.apply(ff.graph, matches[0])
+    add = next(n for n in ng.topo_order() if n.op_type == OT.OP_EW_ADD)
+    # batch dim carries the partition degree inside the rewrite region
+    assert add.outputs[0].shape.dims[0].degree == 2
