@@ -46,7 +46,8 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ff_eval_makespan.restype = ctypes.c_double
         lib.ff_eval_makespan.argtypes = [
             ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
-            ctypes.c_int32, ctypes.POINTER(ctypes.c_double)]
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32, i32p, i32p]
         _lib = lib
     except Exception:
         _lib = None
@@ -106,12 +107,18 @@ def idominators(n: int, src, dst) -> Optional[np.ndarray]:
     return out if rc == 0 else None
 
 
-def eval_makespan(node_costs, edge_costs) -> Optional[float]:
+def eval_makespan(compute, comm, src, dst) -> Optional[float]:
+    """Critical-path makespan with serialized compute (ff_eval_makespan):
+    max(sum(compute), longest path of compute+comm). None if the native lib
+    is unavailable; -1.0 propagates a cycle error."""
     lib = _load()
     if lib is None:
         return None
-    nc = np.ascontiguousarray(node_costs, np.float64)
-    ec = np.ascontiguousarray(edge_costs, np.float64)
-    return lib.ff_eval_makespan(
-        len(nc), nc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-        len(ec), ec.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    co = np.ascontiguousarray(compute, np.float64)
+    cm = np.ascontiguousarray(comm, np.float64)
+    src, dst = _as_i32(src), _as_i32(dst)
+    out = lib.ff_eval_makespan(
+        len(co), co.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        cm.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(src), _ptr(src), _ptr(dst))
+    return None if out < 0 else float(out)

@@ -146,18 +146,33 @@ int ff_idominators(int32_t n, int32_t m, const int32_t* src,
   return 0;
 }
 
-// Strategy-evaluation hot loop for the Unity search: given per-node config
-// choices as precomputed cost tables, accumulate the makespan. Layout:
-//   node_cost[i]   = compute+sync cost of node i under its chosen config
-//   edge_cost[e]   = reshard cost of edge e under (src config, dst config)
-// This exists so Python can offload the O(nodes+edges) inner loop of
-// best-first refinement (thousands of evaluations) to native code.
-double ff_eval_makespan(int32_t n, const double* node_cost, int32_t m,
-                        const double* edge_cost) {
-  double total = 0.0;
-  for (int32_t i = 0; i < n; i++) total += node_cost[i];
-  for (int32_t e = 0; e < m; e++) total += edge_cost[e];
-  return total;
+// Strategy-evaluation hot loop for the Unity search (the simulate_runtime
+// analog, reference simulator.cc). Model: every op runs on all chips, so
+// compute serializes across the whole set (sum of compute); communication
+// (reshards, psums, gradient sync) can overlap compute of *other* ops but
+// not its own dependency chain, so the critical path of (compute + comm)
+// is a second lower bound — concurrent branches (DLRM towers, Inception)
+// take the max of their paths instead of the sum:
+//   makespan = max( sum_i compute[i],
+//                   max over paths P of sum_{i in P} (compute[i]+comm[i]) )
+// Returns -1.0 on cycle.
+double ff_eval_makespan(int32_t n, const double* compute, const double* comm,
+                        int32_t m, const int32_t* src, const int32_t* dst) {
+  std::vector<int32_t> order(n);
+  if (ff_topo_order(n, m, src, dst, order.data()) != 0) return -1.0;
+  std::vector<std::vector<int32_t>> preds(n);
+  for (int32_t i = 0; i < m; i++) preds[dst[i]].push_back(src[i]);
+  std::vector<double> finish(n, 0.0);
+  double total_compute = 0.0, critical = 0.0;
+  for (int32_t i = 0; i < n; i++) {
+    int32_t v = order[i];
+    double start = 0.0;
+    for (int32_t p : preds[v]) start = std::max(start, finish[p]);
+    finish[v] = start + compute[v] + comm[v];
+    critical = std::max(critical, finish[v]);
+    total_compute += compute[v];
+  }
+  return std::max(total_compute, critical);
 }
 
 }  // extern "C"
