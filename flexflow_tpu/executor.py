@@ -63,7 +63,13 @@ class Executor:
         self.order = graph.topo_order()
         self.logits_node = logits_node
         self.label_spec = label_spec
-        self.last_op_is_softmax = logits_node.op_type == OT.OP_SOFTMAX
+        # A substitution rewrite may have interposed Combine/Repartition/...
+        # nodes between the real softmax and the marked logits node; walk
+        # back through value-preserving parallel ops so the loss doesn't
+        # re-apply log-softmax to probabilities after such a rewrite.
+        self.last_op_is_softmax = (
+            _terminal_compute_op(graph, logits_node).op_type == OT.OP_SOFTMAX
+        )
         # Mixed precision (config.py): compute_dtype != None → bf16/fp16
         # activations with fp32 master weights; matmul_dtype → MXU input cast
         # for fp32 matmuls (tensor-op math analog).
@@ -287,6 +293,29 @@ class Executor:
             spec = specs.get(name, PartitionSpec())
             out[name] = jax.device_put(arr, NamedSharding(self.mesh, spec))
         return out
+
+
+# Reduction and FusedParallelOp are deliberately excluded: a (fused)
+# Reduction sums partial results, changing the value.
+_VALUE_PRESERVING = frozenset({
+    OT.OP_REPARTITION, OT.OP_COMBINE, OT.OP_REPLICATE,
+    OT.OP_PIPELINE, OT.OP_NOOP, OT.OP_IDENTITY,
+})
+
+
+def _terminal_compute_op(graph: Graph, node: OpNode) -> OpNode:
+    """Walk back through parallel/identity ops that only re-place (not
+    transform) their input, to the op that actually computed the value.
+    (Reduction is excluded: it sums partial results, changing the value.)"""
+    seen = set()
+    while node.op_type in _VALUE_PRESERVING and node.guid not in seen:
+        seen.add(node.guid)
+        edges = graph.in_edges[node.guid]
+        if not edges:
+            break
+        src = min(edges, key=lambda e: e.dst_idx)
+        node = graph.nodes[src.src]
+    return node
 
 
 def _spec_nontrivial(spec: PartitionSpec) -> bool:

@@ -31,7 +31,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
-LSE_LANES = 8  # minor dim of the (seq,) row-stat tensors for TPU tiling
+# Minor dim of the (seq,) row-stat tensors (lse/delta): Mosaic requires
+# 128-lane minor blocks for f32 (the in-tree jax flash kernel's
+# MIN_BLOCK_SIZE), so 8 lanes would mis-tile or fail to lower on real TPU.
+LSE_LANES = 128
 
 
 def _attn_reference(q, k, v, causal: bool, scale: float):

@@ -29,9 +29,9 @@ def _load() -> Optional[ctypes.CDLL]:
         return _lib
     _lib_tried = True
     try:
-        if not os.path.exists(_LIB_PATH):
-            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                           capture_output=True)
+        # make's own dependency check rebuilds iff pcg_core.cc is newer
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True)
         lib = ctypes.CDLL(_LIB_PATH)
         i32p = ctypes.POINTER(ctypes.c_int32)
         lib.ff_topo_order.restype = ctypes.c_int
@@ -110,7 +110,9 @@ def idominators(n: int, src, dst) -> Optional[np.ndarray]:
 def eval_makespan(compute, comm, src, dst) -> Optional[float]:
     """Critical-path makespan with serialized compute (ff_eval_makespan):
     max(sum(compute), longest path of compute+comm). None if the native lib
-    is unavailable; -1.0 propagates a cycle error."""
+    is unavailable; raises ValueError on a cyclic graph (the two cases must
+    stay distinguishable so a cyclic candidate is rejected rather than
+    silently re-costed by the Python fallback)."""
     lib = _load()
     if lib is None:
         return None
@@ -121,4 +123,6 @@ def eval_makespan(compute, comm, src, dst) -> Optional[float]:
         len(co), co.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         cm.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         len(src), _ptr(src), _ptr(dst))
-    return None if out < 0 else float(out)
+    if out < 0:
+        raise ValueError("eval_makespan: graph has a cycle")
+    return float(out)

@@ -274,12 +274,26 @@ _PARALLEL = frozenset({
     OT.OP_FUSED_PARALLEL, OT.OP_PIPELINE,
 })
 
+# Ops that commute with summation: f(sum_i x_i) == sum_i f(x_i). Only these
+# may pass a partial-sum replica dim (row-parallel Linear/MHA output)
+# through unchanged; relu(partial sums) != partial(relu).
+_LINEAR_SAFE = frozenset({
+    OT.OP_IDENTITY, OT.OP_CAST, OT.OP_SCALAR_MULTIPLY,
+    OT.OP_SCALAR_TRUE_DIV,
+})
+
 
 def propagate_parallel_state(graph: Graph):
     """Re-derive every tensor's ParallelDim degrees and every compute op's
     implied weight shardings from the graph's explicit parallel ops — the
     solve_parallel_dim_mappings analog (reference operator.cc /
-    ParallelDimMappingRecord). Raises ValueError on inconsistent state."""
+    ParallelDimMappingRecord). Raises ValueError on inconsistent state,
+    including a partial-sum replica dim flowing through a nonlinear op
+    (such a candidate would be mathematically invalid)."""
+    # (guid, out_idx) -> True when the tensor's replica dim holds PARTIAL
+    # SUMS (row-parallel Linear / head-parallel MHA output) rather than
+    # identical copies (Replicate output)
+    partial: dict[tuple[int, int], bool] = {}
     for node in graph.topo_order():
         if node.op_type == OT.OP_INPUT:
             if not node.outputs:
@@ -287,29 +301,75 @@ def propagate_parallel_state(graph: Graph):
             node.inputs = []
             continue
         in_pts: list[ParallelTensor] = []
-        for e in sorted(graph.in_edges[node.guid], key=lambda e: e.dst_idx):
+        in_edges = sorted(graph.in_edges[node.guid], key=lambda e: e.dst_idx)
+        for e in in_edges:
             in_pts.append(graph.nodes[e.src].outputs[e.src_idx])
         node.inputs = in_pts
+        in_partial = [partial.get((e.src, e.src_idx), False)
+                      for e in in_edges]
         in_shapes = [pt.shape for pt in in_pts]
         weight_partition: dict[str, tuple[int, int]] = {}
+        out_partial = False
 
         if node.op_type in _PARALLEL:
             out_shapes = [apply_parallel_op_shape(
                 in_shapes[0], node.op_type, node.params)]
+            # Reduction consumes partial sums; the others re-place values.
+            # A FusedParallelOp is checked per sub-op so a fused Reduction
+            # can't bypass the identical-replica check.
+            sub_types = ([i.op_type for i in node.params.ops]
+                         if node.op_type == OT.OP_FUSED_PARALLEL
+                         else [node.op_type])
+            cur = in_partial[0] if in_partial else False
+            for st in sub_types:
+                if st == OT.OP_REDUCTION:
+                    if not cur:
+                        raise ValueError(
+                            f"{node.name}: Reduction over identical "
+                            f"replicas would multiply values by the degree")
+                    cur = False
+            out_partial = cur
         elif node.op_type == OT.OP_LINEAR:
+            if any(in_partial):
+                raise ValueError(
+                    f"{node.name}: Linear consuming a partial-sum tensor "
+                    f"is unsupported (bias would be added per replica)")
             out_shapes = [_linear_parallel(node, in_shapes[0],
                                            weight_partition)]
+            out_partial = any(d.is_replica_dim for d in out_shapes[0].dims)
         elif node.op_type == OT.OP_MULTIHEAD_ATTENTION:
+            if any(in_partial):
+                raise ValueError(
+                    f"{node.name}: attention over partial sums is invalid "
+                    f"(softmax is nonlinear)")
             out_shapes = [_attention_parallel(node, in_shapes,
                                               weight_partition)]
+            out_partial = any(d.is_replica_dim for d in out_shapes[0].dims)
         elif node.op_type in _PASSTHROUGH:
+            if in_partial and in_partial[0] and \
+                    node.op_type not in _LINEAR_SAFE:
+                raise ValueError(
+                    f"{node.name} ({node.op_type.name}) is nonlinear and "
+                    f"cannot consume a partial-sum replica dim: "
+                    f"f(sum x_i) != sum f(x_i)")
             out_shapes = [in_shapes[0]]
+            out_partial = in_partial[0] if in_partial else False
         elif node.op_type in (OT.OP_EW_ADD, OT.OP_EW_SUB, OT.OP_EW_MUL,
                               OT.OP_EW_DIV, OT.OP_EW_MAX, OT.OP_EW_MIN):
             if in_shapes[0].dims != in_shapes[1].dims:
                 raise ValueError(
                     f"{node.name}: element-binary operands have different "
                     f"parallel shapes {in_shapes[0]} vs {in_shapes[1]}")
+            if any(in_partial):
+                # add/sub of two partials distributes over the sum; any
+                # other combination (mixed partial/full, nonlinear binop)
+                # does not
+                if not (all(in_partial) and node.op_type in
+                        (OT.OP_EW_ADD, OT.OP_EW_SUB)):
+                    raise ValueError(
+                        f"{node.name} ({node.op_type.name}): invalid "
+                        f"combination of partial-sum operands")
+                out_partial = True
             out_shapes = [in_shapes[0]]
         else:
             # generic op: forbid replica dims, propagate positional degrees
@@ -346,6 +406,7 @@ def propagate_parallel_state(graph: Graph):
             pt = ParallelTensor(shape, name=name)
             pt.owner_op, pt.owner_idx = node, i
             node.outputs.append(pt)
+            partial[(node.guid, i)] = out_partial
         node._weight_partition = weight_partition
 
 

@@ -82,6 +82,27 @@ def test_bottlenecks_matches_python_on_real_graph(lib_ok):
     assert native_names == py_names
 
 
-def test_eval_makespan(lib_ok):
-    total = native.eval_makespan([1.0, 2.0, 3.0], [0.5, 0.5])
+def test_eval_makespan_chain(lib_ok):
+    # chain 0->1->2: critical path = (1+0.5)+(2+0.5)+(3+0) = 7 > sum compute 6
+    total = native.eval_makespan([1.0, 2.0, 3.0], [0.5, 0.5, 0.0],
+                                 [0, 1], [1, 2])
     assert total == pytest.approx(7.0)
+
+
+def test_eval_makespan_concurrent_branches(lib_ok):
+    # diamond 0 -> {1,2} -> 3 (two-tower DLRM shape): comm-heavy branches
+    # overlap, so makespan = max(sum compute, critical path), NOT the sum
+    # of both branches' comm.
+    compute = [1.0, 1.0, 1.0, 1.0]
+    comm = [0.0, 5.0, 5.0, 0.0]
+    total = native.eval_makespan(compute, comm, [0, 0, 1, 2], [1, 2, 3, 3])
+    # critical path = 1 + (1+5) + 1 = 8; sum compute = 4
+    assert total == pytest.approx(8.0)
+    # pure-compute diamond: compute serializes (chips are shared) -> sum
+    total = native.eval_makespan(compute, [0.0] * 4, [0, 0, 1, 2], [1, 2, 3, 3])
+    assert total == pytest.approx(4.0)
+
+
+def test_eval_makespan_cycle(lib_ok):
+    with pytest.raises(ValueError, match="cycle"):
+        native.eval_makespan([1.0, 1.0], [0.0, 0.0], [0, 1], [1, 0])

@@ -19,7 +19,7 @@ from flexflow_tpu import (
     LossType,
     SGDOptimizer,
 )
-from flexflow_tpu.fftype import OperatorType as OT
+from flexflow_tpu.fftype import DataType, OperatorType as OT
 
 
 def _mk_config(argv=()):
@@ -226,3 +226,104 @@ def test_partition_add_combine_shapes():
     add = next(n for n in ng.topo_order() if n.op_type == OT.OP_EW_ADD)
     # batch dim carries the partition degree inside the rewrite region
     assert add.outputs[0].shape.dims[0].degree == 2
+
+
+def test_partial_sum_through_nonlinear_rejected():
+    """A rule composition interposing a nonlinear op between a row-parallel
+    producer and its Reduction must be discarded as invalid (ADVICE r2):
+    relu(partial sums) != partial(relu)."""
+    from flexflow_tpu.parallel.ops import ReductionParams, ReplicateParams
+    from flexflow_tpu.pcg.graph import Graph, OpNode
+    from flexflow_tpu.search.substitution import propagate_parallel_state
+    from flexflow_tpu.tensor import ParallelTensor, ParallelTensorShape
+
+    config = _mk_config(["-b", "8", "--mesh", "2,2,1,1"])
+    ff, _ = _attn_model(config, prefix="ps")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    def build(interpose: OT):
+        g = Graph()
+        inp = OpNode(OT.OP_INPUT, None, name="x")
+        inp.outputs = [ParallelTensor(
+            ParallelTensorShape.from_shape((8, 16, 32), DataType.DT_FLOAT),
+            name="x")]
+        g.add_node(inp)
+        attn_src = next(n for n in ff.graph.topo_order()
+                        if n.op_type == OT.OP_MULTIHEAD_ATTENTION)
+        repl = OpNode(OT.OP_REPLICATE, ReplicateParams(2))
+        g.add_node(repl)
+        g.add_edge(inp, repl, 0, 0)
+        attn = OpNode(OT.OP_MULTIHEAD_ATTENTION, attn_src.params,
+                      name="attn", initializers=attn_src.initializers)
+        attn.weight_specs = list(attn_src.weight_specs)
+        g.add_node(attn)
+        for i in range(3):
+            g.add_edge(repl, attn, 0, i)
+        mid = OpNode(interpose, None, name="mid")
+        g.add_node(mid)
+        g.add_edge(attn, mid, 0, 0)
+        red = OpNode(OT.OP_REDUCTION, ReductionParams(2))
+        g.add_node(red)
+        g.add_edge(mid, red, 0, 0)
+        return g
+
+    # nonlinear interposer: invalid candidate, must raise
+    with pytest.raises(ValueError, match="nonlinear"):
+        propagate_parallel_state(build(OT.OP_RELU))
+    # linearity-safe interposer (identity) is fine
+    propagate_parallel_state(build(OT.OP_IDENTITY))
+
+
+def test_reduction_over_pure_replicas_rejected():
+    from flexflow_tpu.parallel.ops import ReductionParams, ReplicateParams
+    from flexflow_tpu.pcg.graph import Graph, OpNode
+    from flexflow_tpu.search.substitution import propagate_parallel_state
+    from flexflow_tpu.tensor import ParallelTensor, ParallelTensorShape
+
+    g = Graph()
+    inp = OpNode(OT.OP_INPUT, None, name="x")
+    inp.outputs = [ParallelTensor(
+        ParallelTensorShape.from_shape((8, 32), DataType.DT_FLOAT),
+        name="x")]
+    g.add_node(inp)
+    repl = OpNode(OT.OP_REPLICATE, ReplicateParams(2))
+    g.add_node(repl)
+    g.add_edge(inp, repl, 0, 0)
+    red = OpNode(OT.OP_REDUCTION, ReductionParams(2))
+    g.add_node(red)
+    g.add_edge(repl, red, 0, 0)
+    with pytest.raises(ValueError, match="identical replicas"):
+        propagate_parallel_state(g)
+
+
+def test_logits_marker_survives_softmax_rewrite():
+    """partition_softmax_combine moves the logits marker onto the inserted
+    Combine; the loss must still detect softmax-ness by walking back
+    (ADVICE r2 medium: silently wrong loss otherwise)."""
+    from flexflow_tpu.search.substitution import (
+        create_partition_softmax_combine,
+        propagate_parallel_state,
+    )
+    from flexflow_tpu.executor import _terminal_compute_op
+
+    config = _mk_config(["-b", "8", "--mesh", "2,1,1,1"])
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 32), name="lm_in")
+    t = ff.dense(x, 8, name="lm_fc")
+    ff.softmax(t, name="lm_sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    sm = next(n for n in ff.graph.topo_order()
+              if n.op_type == OT.OP_SOFTMAX)
+    sm._is_logits = True
+    xfer = create_partition_softmax_combine(2)
+    matches = xfer.find_matches(ff.graph)
+    assert len(matches) == 1
+    ng = xfer.apply(ff.graph, matches[0])
+    marked = [n for n in ng.topo_order()
+              if getattr(n, "_is_logits", False)]
+    assert len(marked) == 1
+    assert marked[0].op_type == OT.OP_COMBINE  # marker moved to Combine
+    # the walk-back recovers the softmax
+    assert _terminal_compute_op(ng, marked[0]).op_type == OT.OP_SOFTMAX
