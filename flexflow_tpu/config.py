@@ -44,6 +44,9 @@ class FFConfig:
     base_optimize_threshold: int = 10
     enable_propagation: bool = False
     perform_memory_search: bool = False
+    # on-device cost-model calibration: measure the top-K distinct ops on
+    # the local chip before searching (measure_operator_cost analog); 0=off
+    search_calibrate: int = 0
     # parallelism gates (reference config.h:133-137)
     only_data_parallel: bool = False
     enable_sample_parallel: bool = False
@@ -178,6 +181,8 @@ class FFConfig:
                 self.search_num_workers = int(val())
             elif a == "--base-optimize-threshold":
                 self.base_optimize_threshold = int(val())
+            elif a == "--calibrate":
+                self.search_calibrate = int(val())
             elif a == "--substitution-json":
                 self.substitution_json_path = val()
             elif a == "--enable-substitutions":

@@ -611,24 +611,15 @@ class FFModel:
         # --- mesh + strategy
         self.mesh = build_mesh(self.config.mesh_shape())
         used_substitutions = False
-        if (
+        use_subst_search = (
             self._strategy is None
             and not self.config.only_data_parallel
             and (self.config.enable_substitutions
                  or self.config.substitution_json_path)
-        ):
-            # substitution half of Unity: explore GraphXfer-rewritten PCGs
-            # that insert explicit parallel ops (substitution.cc:1898+);
-            # the winning graph replaces the layer-built one and arrives
-            # with mesh axes + weight shardings already emitted
-            from .search.substitution import graph_optimize
-
-            tensor_to_out[self.layers[-1].outputs[0].tensor_guid][0]._is_logits = True
-            g = graph_optimize(g, self.mesh, self.config)
-            self.graph = g
-            used_substitutions = True
-        elif (
-            self._strategy is None
+        )
+        use_config_search = (
+            not use_subst_search
+            and self._strategy is None
             and not self.config.only_data_parallel
             and self.mesh.shape.get(AXIS_MODEL, 1) > 1
             and (
@@ -636,12 +627,36 @@ class FFModel:
                 or self.config.enable_parameter_parallel
                 or self.config.enable_attribute_parallel
             )
-        ):
+        )
+        cost_model = None
+        if use_subst_search or use_config_search:
+            from .search.cost_model import CostModel
+            from .search.machine_model import machine_model_for_mesh
+
+            cost_model = CostModel(machine_model_for_mesh(self.mesh))
+            if self.config.search_calibrate > 0:
+                # measure the dominant ops on the local chip so the search
+                # costs candidates from measurements, not the mfu guess
+                # (Simulator::measure_operator_cost, model.cu:38-75)
+                cost_model.calibrate_graph(
+                    g, top_k=self.config.search_calibrate)
+        if use_subst_search:
+            # substitution half of Unity: explore GraphXfer-rewritten PCGs
+            # that insert explicit parallel ops (substitution.cc:1898+);
+            # the winning graph replaces the layer-built one and arrives
+            # with mesh axes + weight shardings already emitted
+            from .search.substitution import graph_optimize
+
+            tensor_to_out[self.layers[-1].outputs[0].tensor_guid][0]._is_logits = True
+            g = graph_optimize(g, self.mesh, self.config, cost_model)
+            self.graph = g
+            used_substitutions = True
+        elif use_config_search:
             # GRAPH_OPTIMIZE_TASK analog: Unity search over the PCG
             from .search import search_strategy
 
             self._strategy = search_strategy(
-                g, self.mesh, self.config
+                g, self.mesh, self.config, cost_model=cost_model
             ).overrides
         if not used_substitutions:
             self._assign_strategy()

@@ -110,6 +110,73 @@ def classify_reshard(shape, from_assign, to_assign, dtype, machine:
     return cost
 
 
+def graph_makespan(compute, comm, src, dst) -> float:
+    """Makespan of a strategy's task graph: max(sum of compute, critical
+    path of compute+comm) — concurrent branches (DLRM towers, Inception)
+    cost max(paths), not sum (the simulate_runtime analog,
+    simulator.h:691-783). Native ff_eval_makespan when the toolchain is
+    available; identical pure-Python fallback otherwise. Raises ValueError
+    on a cyclic graph."""
+    from .. import native
+
+    res = native.eval_makespan(compute, comm, src, dst)
+    if res is not None:
+        return res
+    n = len(compute)
+    preds: list[list[int]] = [[] for _ in range(n)]
+    succs: list[list[int]] = [[] for _ in range(n)]
+    indeg = [0] * n
+    for s, d in zip(src, dst):
+        preds[d].append(s)
+        succs[s].append(d)
+        indeg[d] += 1
+    ready = [v for v in range(n) if indeg[v] == 0]
+    finish = [0.0] * n
+    critical = 0.0
+    done = 0
+    while ready:
+        v = ready.pop()
+        done += 1
+        start = max((finish[p] for p in preds[v]), default=0.0)
+        finish[v] = start + compute[v] + comm[v]
+        critical = max(critical, finish[v])
+        for w in succs[v]:
+            indeg[w] -= 1
+            if indeg[w] == 0:
+                ready.append(w)
+    if done != n:
+        raise ValueError("graph_makespan: graph has a cycle")
+    return max(float(sum(compute)), critical)
+
+
+class _MakespanAccum:
+    """Collects per-node (compute, comm) costs + dependency edges during a
+    strategy evaluation, then evaluates the makespan. Shared by both search
+    evaluators so neither prices a branchy graph as a serial sum."""
+
+    def __init__(self):
+        self.compute: list[float] = []
+        self.comm: list[float] = []
+        self.idx: dict[int, int] = {}  # node guid -> task index
+
+    def add(self, guid: int, compute: float, comm: float):
+        self.idx[guid] = len(self.compute)
+        self.compute.append(compute)
+        self.comm.append(comm)
+
+    def makespan(self, in_edges) -> float:
+        src, dst = [], []
+        for guid, i in self.idx.items():
+            for e in in_edges[guid]:
+                j = self.idx.get(e.src)
+                if j is not None:
+                    src.append(j)
+                    dst.append(i)
+        if not self.compute:
+            return 0.0
+        return graph_makespan(self.compute, self.comm, src, dst)
+
+
 class CostModel:
     """Costs one node / one whole strategy; memoized like the reference's
     (params, view) cache (simulator.h strict/relaxed hash caches)."""
@@ -187,8 +254,15 @@ class CostModel:
 
         eff_peak_t = self.machine.compute_time(shard_flops / self.mfu,
                                                bytes_touched)
-        calib = self._calibration.get(_params_key(node))
-        fwd = calib if calib is not None else eff_peak_t
+        # measured full-op time (calibrate_graph) overrides the fixed-mfu
+        # roofline; scale by the shard fraction since the measurement is of
+        # the unsharded op on one chip
+        calib = self._calibration.get(
+            _params_key(node, tuple(tuple(s) for s in in_shapes)))
+        if calib is not None:
+            fwd = calib * shard_flops / max(full_flops, 1.0)
+        else:
+            fwd = eff_peak_t
         # rule of thumb (also the reference simulator's default): bwd ≈ 2× fwd
         cm = CostMetrics(
             forward_time=fwd,
@@ -222,11 +296,96 @@ class CostModel:
         jax.block_until_ready(out)
         t = (time.perf_counter() - t0) / reps
         self._calibration[_params_key(node)] = t
+        self._cache.clear()  # cached roofline entries are stale now
         return t
 
+    def calibrate_graph(self, graph, top_k: int = 4) -> int:
+        """Measure the top-K most expensive distinct ops of a PCG on the
+        local device and pin their costs — the reference measures *every*
+        candidate op on GPU0 (simulator.h:691-783); we measure the K that
+        dominate the roofline estimate. Returns the number of ops measured.
+        Failures (unsupported harness shapes) are skipped, leaving the
+        roofline estimate in place."""
+        candidates: dict = {}
+        for node in graph.topo_order():
+            if (node.op_type in _NON_COMPUTE or not node.outputs
+                    or not node.inputs):
+                continue
+            key = _params_key(node)
+            if key in self._calibration or key in candidates:
+                continue
+            try:
+                in_shapes = [pt.shape.logical_shape for pt in node.inputs]
+                out_shapes = [pt.shape.logical_shape for pt in node.outputs]
+                est = node.op_def.flops(node.params, in_shapes, out_shapes)
+            except Exception:
+                continue
+            candidates[key] = (est, node)
+        measured = 0
+        ranked = sorted(candidates.values(), key=lambda kv: -kv[0])[:top_k]
+        for _, node in ranked:
+            try:
+                fn, args = _op_harness(node)
+                self.calibrate(node, fn, args)
+                measured += 1
+            except Exception:
+                continue
+        return measured
 
-def _params_key(node):
-    return (node.op_type, repr(node.params))
+
+_NON_COMPUTE = frozenset({
+    OT.OP_INPUT, OT.OP_WEIGHT, OT.OP_NOOP, OT.OP_REPARTITION, OT.OP_COMBINE,
+    OT.OP_REPLICATE, OT.OP_REDUCTION, OT.OP_FUSED_PARALLEL, OT.OP_PIPELINE,
+})
+
+
+def _op_harness(node):
+    """Build (fn, example_args) measuring one op's unsharded forward on the
+    local device (the sub-tensor construction of measure_operator_cost,
+    linear.cc:792-925, without the MachineView — sharding is applied as a
+    flops ratio by op_cost)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..fftype import dtype_to_jnp
+    from ..ops.base import OpContext
+
+    rs = np.random.RandomState(0)
+
+    def _make(shape, dtype):
+        jt = dtype_to_jnp(dtype)
+        if jnp.issubdtype(jt, jnp.integer):
+            return jnp.zeros(shape, jt)
+        return jnp.asarray(rs.randn(*shape), jt)
+
+    ins = [_make(pt.shape.logical_shape, pt.dtype) for pt in node.inputs]
+    weights = {ws.name: _make(ws.shape, ws.dtype)
+               for ws in node.weight_specs}
+    state = {ws.name: weights[ws.name] for ws in node.weight_specs
+             if not ws.trainable}
+    ctx = OpContext(training=False, rng=jax.random.key(0))
+    params, op_def = node.params, node.op_def
+
+    def fn(*arrs):
+        outs, _ = op_def.forward(params, list(arrs), weights,
+                                 dict(state) if state else None, ctx)
+        return outs[0]
+
+    return fn, tuple(ins)
+
+
+def _params_key(node, in_shapes=None):
+    """Calibration cache key: op params alone don't pin the cost (a
+    64→4096 Linear and a 4096→4096 Linear share LinearParams fields), so
+    the key includes the unsharded input shapes — the analog of the
+    reference caching by (OperatorParameters, MachineView) where the view
+    implies the sub-tensor shapes."""
+    if in_shapes is None:
+        in_shapes = (tuple(pt.shape.logical_shape for pt in node.inputs)
+                     if node.inputs else ())
+    return (node.op_type, repr(node.params),
+            tuple(tuple(s) for s in in_shapes))
 
 
 def _spec_to_assignment(spec, ndim):

@@ -533,9 +533,14 @@ def evaluate_graph(graph: Graph, mesh, cm: CostModel) -> tuple[float, float]:
     """(time, per-chip memory) of a rewritten PCG: compute ops through the
     cost model on their emitted assignments; parallel ops priced as the
     collectives they lower to (the reference prices them as partition-copy
-    tasks via the simulator)."""
+    tasks via the simulator). Total time is the task-graph makespan
+    (graph_makespan / native ff_eval_makespan) — comm on concurrent
+    branches overlaps compute of other ops instead of summing serially."""
+    from .cost_model import _MakespanAccum
+
     assign_axes_from_degrees(graph, mesh)
-    total, mem = 0.0, 0.0
+    acc = _MakespanAccum()
+    mem = 0.0
     machine = cm.machine
     for node in graph.topo_order():
         if node.op_type in (OT.OP_INPUT, OT.OP_WEIGHT, OT.OP_NOOP):
@@ -544,19 +549,32 @@ def evaluate_graph(graph: Graph, mesh, cm: CostModel) -> tuple[float, float]:
             pt = node.inputs[0]
             local_bytes = (pt.shape.piece_elements()
                            * dtype_bytes(pt.dtype))
-            if node.op_type == OT.OP_COMBINE:
-                ax = _degree_axis(machine, node.params.degree)
-                total += machine.all_gather(
-                    local_bytes * node.params.degree, ax)
-            elif node.op_type == OT.OP_REPARTITION:
-                if pt.shape.total_degree > 1:
-                    ax = _degree_axis(machine, node.params.degree)
-                    total += machine.all_to_all(local_bytes, ax)
-                # from fully-replicated: local slice, free
-            elif node.op_type == OT.OP_REDUCTION:
-                ax = _degree_axis(machine, node.params.degree)
-                total += machine.all_reduce(local_bytes, ax)
-            # Replicate: broadcast of an already-replicated tensor — free
+            # price each (sub-)transform as the collective it lowers to; a
+            # FusedParallelOp pays for its member Reduction/Combine/... the
+            # same as the unfused sequence would (otherwise base_optimize
+            # would prefer fused rewrites purely because they looked free)
+            sub = (node.params.ops
+                   if node.op_type == OT.OP_FUSED_PARALLEL
+                   else [node.params])
+            sub_types = ([i.op_type for i in node.params.ops]
+                         if node.op_type == OT.OP_FUSED_PARALLEL
+                         else [node.op_type])
+            comm = 0.0
+            for st, sp in zip(sub_types, sub):
+                if st == OT.OP_COMBINE:
+                    ax = _degree_axis(machine, sp.degree)
+                    comm += machine.all_gather(local_bytes * sp.degree, ax)
+                elif st == OT.OP_REPARTITION:
+                    if pt.shape.total_degree > 1:
+                        ax = _degree_axis(machine, sp.degree)
+                        comm += machine.all_to_all(local_bytes, ax)
+                    # from fully-replicated: local slice, free
+                elif st == OT.OP_REDUCTION:
+                    ax = _degree_axis(machine, sp.degree)
+                    comm += machine.all_reduce(local_bytes, ax)
+                # Replicate: broadcast of an already-replicated tensor and
+                # Pipeline stage markers are free
+            acc.add(node.guid, 0.0, comm)
             continue
         in_shapes, in_assigns = [], []
         for pt in node.inputs:
@@ -565,9 +583,10 @@ def evaluate_graph(graph: Graph, mesh, cm: CostModel) -> tuple[float, float]:
         cmx = cm.op_cost(
             node, [_logical_assignment(pt) for pt in node.outputs],
             dict(node.weight_axes), in_shapes, in_assigns)
-        total += cmx.total
+        acc.add(node.guid, cmx.forward_time + cmx.backward_time,
+                cmx.sync_time + cmx.comm_time)
         mem += cmx.memory
-    return total, mem
+    return acc.makespan(graph.in_edges), mem
 
 
 def _logical_assignment(pt: ParallelTensor):

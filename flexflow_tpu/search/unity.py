@@ -38,6 +38,7 @@ from ..machine import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
 from ..parallel.strategies import Strategy
 from .cost_model import (
     CostModel,
+    _MakespanAccum,
     _axes_of,
     _shard_elems,
     _spec_to_assignment,
@@ -154,8 +155,13 @@ class UnitySearch:
 
     def evaluate(self, choice: dict) -> tuple[float, float]:
         """(makespan seconds, peak per-chip memory bytes) of a full
-        assignment {guid -> NodeConfig} — the simulate_runtime analog."""
-        total = 0.0
+        assignment {guid -> NodeConfig} — the simulate_runtime analog:
+        per-node compute serializes across the chip set while communication
+        overlaps other ops' compute, so the result is
+        max(sum compute, critical path of compute+comm) via graph_makespan
+        (native ff_eval_makespan), not an additive sum — concurrent
+        branches (DLRM towers) are priced at max(paths)."""
+        acc = _MakespanAccum()
         mem = 0.0
         for node in self.order:
             if node.op_type in (OT.OP_INPUT, OT.OP_WEIGHT, OT.OP_NOOP):
@@ -195,9 +201,11 @@ class UnitySearch:
                           if not d.is_replica_dim),
                     cfg.out_assign, self.axis_sizes) * dtype_bytes(out_pt.dtype)
                 psum += self.cm.machine.all_reduce(shard_bytes, ax)
-            total += cm.total + reshard + psum
+            acc.add(node.guid,
+                    cm.forward_time + cm.backward_time,
+                    cm.sync_time + cm.comm_time + reshard + psum)
             mem += cm.memory
-        return total, mem
+        return acc.makespan(self.graph.in_edges), mem
 
     def _expected_input(self, node, cfg, dst_idx, ndim):
         """The input spec a config consumes (None = producer's choice OK)."""

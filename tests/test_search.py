@@ -154,3 +154,129 @@ def test_bottleneck_detection():
     names = {n.name for n in s.bottlenecks()}
     assert "a" in names and "c" in names
     assert "b1" not in names and "b2" not in names
+
+
+def test_graph_makespan_fallback_matches_native():
+    """The pure-Python fallback and the native ff_eval_makespan implement
+    the same model."""
+    from flexflow_tpu import native
+    from flexflow_tpu.search.cost_model import graph_makespan
+
+    compute = [1.0, 1.0, 1.0, 1.0]
+    comm = [0.0, 5.0, 5.0, 0.0]
+    src, dst = [0, 0, 1, 2], [1, 2, 3, 3]
+    got = graph_makespan(compute, comm, src, dst)
+    assert got == pytest.approx(8.0)  # 1 + (1+5) + 1, not sum of branches
+    saved, saved_t = native._lib, native._lib_tried
+    native._lib, native._lib_tried = None, True
+    try:
+        assert graph_makespan(compute, comm, src, dst) == pytest.approx(got)
+        with pytest.raises(ValueError, match="cycle"):
+            graph_makespan([1.0, 1.0], [0.0, 0.0], [0, 1], [1, 0])
+    finally:
+        native._lib, native._lib_tried = saved, saved_t
+
+
+def test_two_tower_costed_as_makespan_not_sum():
+    """A DLRM-style two-tower graph with comm-heavy parallel branches must
+    be costed at max(paths), not the serial sum (VERDICT r2 item 2)."""
+    sys.argv = ["test"]
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.search import CostModel, UnitySearch, machine_model_for_mesh
+
+    config = FFConfig()
+    config.mesh_axis_sizes = (2, 2, 1, 1)
+    config.batch_size = 16
+    ff = FFModel(config)
+    x = ff.create_tensor((16, 64))
+    a = ff.dense(x, 4096, name="mk_stem")
+    t1 = ff.dense(a, 4096, name="mk_tower1")
+    t2 = ff.dense(a, 4096, name="mk_tower2")
+    c = ff.add(t1, t2, name="mk_join")
+    ff.softmax(ff.dense(c, 8, name="mk_head"), name="mk_sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    s = UnitySearch(ff.graph, ff.mesh, config,
+                    CostModel(machine_model_for_mesh(ff.mesh)))
+    # give both towers row-parallel configs (psum comm on each branch)
+    choice = {}
+    for n in s.order:
+        cfgs = s.node_configs(n)
+        tp_row = [c_ for c_ in cfgs if c_.name == "tp_row"]
+        choice[n.guid] = (tp_row[0] if tp_row and "tower" in n.name
+                          else cfgs[0])
+    # spy on what evaluate() feeds the accumulator so we can compare the
+    # makespan against the old additive evaluator's sum
+    from flexflow_tpu.search.cost_model import _MakespanAccum
+    rows = []
+    orig = _MakespanAccum.add
+
+    class Spy(_MakespanAccum):
+        def add(self, guid, compute, comm):
+            rows.append((guid, compute, comm))
+            orig(self, guid, compute, comm)
+
+    import flexflow_tpu.search.unity as unity_mod
+    saved = unity_mod._MakespanAccum
+    unity_mod._MakespanAccum = Spy
+    try:
+        cost, _ = s.evaluate(choice)
+    finally:
+        unity_mod._MakespanAccum = saved
+    total_compute = sum(r[1] for r in rows)
+    total_comm = sum(r[2] for r in rows)
+    assert total_comm > 0  # the tp_row towers do carry psum comm
+    # makespan is strictly below the old additive result: the two towers'
+    # comm overlaps other work instead of serializing
+    assert cost < total_compute + total_comm
+    # and it still respects the serialized-compute lower bound
+    assert cost >= total_compute - 1e-12
+
+
+def test_calibration_overrides_roofline():
+    """CostModel.calibrate_graph measures the dominant op and the measured
+    time replaces the fixed-mfu roofline estimate (VERDICT r2 item 2)."""
+    sys.argv = ["test"]
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.search import CostModel, machine_model_for_mesh
+    from flexflow_tpu.search.cost_model import _params_key
+
+    config = FFConfig()
+    config.mesh_axis_sizes = (1, 1, 1, 1)
+    config.batch_size = 8
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 64))
+    t = ff.dense(x, 256, name="cal_fc1")
+    ff.softmax(ff.dense(t, 8, name="cal_head"), name="cal_sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    cm = CostModel(machine_model_for_mesh(ff.mesh))
+    fc1 = next(n for n in ff.graph.topo_order() if n.name == "cal_fc1")
+    before = cm.op_cost(fc1, [((),) * 2], {}, [(8, 64)], [((),) * 2])
+    n_measured = cm.calibrate_graph(ff.graph, top_k=1)
+    assert n_measured == 1
+    assert _params_key(fc1) in cm._calibration
+    after = cm.op_cost(fc1, [((),) * 2], {}, [(8, 64)], [((),) * 2])
+    measured = cm._calibration[_params_key(fc1)]
+    # forward now equals the measurement (full op, degree 1), not the
+    # roofline estimate
+    assert after.forward_time == pytest.approx(measured, rel=1e-6)
+    assert after.forward_time != pytest.approx(before.forward_time, rel=1e-3)
+
+
+def test_calibrate_flag_reaches_compile():
+    sys.argv = ["test", "--calibrate", "2", "--budget", "2",
+                "--enable-parameter-parallel"]
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+
+    config = FFConfig()
+    config.mesh_axis_sizes = (2, 2, 1, 1)
+    config.batch_size = 16
+    assert config.search_calibrate == 2
+    ff = FFModel(config)
+    x = ff.create_tensor((16, 32))
+    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="cf_fc1")
+    ff.softmax(ff.dense(t, 8, name="cf_head"), name="cf_sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    assert ff._compiled
