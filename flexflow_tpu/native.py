@@ -48,6 +48,11 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_double),
             ctypes.c_int32, i32p, i32p]
+        lib.ff_eval_makespan_axes.restype = ctypes.c_double
+        lib.ff_eval_makespan_axes.argtypes = [
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double), i32p,
+            ctypes.c_int32, i32p, i32p]
         _lib = lib
     except Exception:
         _lib = None
@@ -125,4 +130,26 @@ def eval_makespan(compute, comm, src, dst) -> Optional[float]:
         len(src), _ptr(src), _ptr(dst))
     if out < 0:
         raise ValueError("eval_makespan: graph has a cycle")
+    return float(out)
+
+
+def eval_makespan_axes(compute, comm, axis, src, dst) -> Optional[float]:
+    """Resource-aware makespan (ff_eval_makespan_axes): adds per-ICI-axis
+    link-occupancy lower bounds — comm tasks on the same mesh axis
+    serialize, disjoint axes overlap (the TPU recast of the reference's
+    horizontal machine-resource splits). axis[i] is an int id, -1 = none.
+    None if the native lib is unavailable; ValueError on a cycle."""
+    lib = _load()
+    if lib is None:
+        return None
+    co = np.ascontiguousarray(compute, np.float64)
+    cm = np.ascontiguousarray(comm, np.float64)
+    ax = _as_i32(axis)
+    src, dst = _as_i32(src), _as_i32(dst)
+    out = lib.ff_eval_makespan_axes(
+        len(co), co.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        cm.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), _ptr(ax),
+        len(src), _ptr(src), _ptr(dst))
+    if out < 0:
+        raise ValueError("eval_makespan_axes: graph has a cycle")
     return float(out)

@@ -110,16 +110,23 @@ def classify_reshard(shape, from_assign, to_assign, dtype, machine:
     return cost
 
 
-def graph_makespan(compute, comm, src, dst) -> float:
+def graph_makespan(compute, comm, src, dst, axis=None) -> float:
     """Makespan of a strategy's task graph: max(sum of compute, critical
     path of compute+comm) — concurrent branches (DLRM towers, Inception)
     cost max(paths), not sum (the simulate_runtime analog,
-    simulator.h:691-783). Native ff_eval_makespan when the toolchain is
-    available; identical pure-Python fallback otherwise. Raises ValueError
-    on a cyclic graph."""
+    simulator.h:691-783). When `axis` is given (int id per node, -1 =
+    none), adds per-ICI-axis link-occupancy bounds — comm on the same mesh
+    axis serializes while disjoint axes overlap, the TPU recast of the
+    reference's horizontal machine-resource splits (graph.cc:267-321).
+    Native ff_eval_makespan[_axes] when the toolchain is available;
+    identical pure-Python fallback otherwise. Raises ValueError on a
+    cyclic graph."""
     from .. import native
 
-    res = native.eval_makespan(compute, comm, src, dst)
+    if axis is not None:
+        res = native.eval_makespan_axes(compute, comm, axis, src, dst)
+    else:
+        res = native.eval_makespan(compute, comm, src, dst)
     if res is not None:
         return res
     n = len(compute)
@@ -146,23 +153,40 @@ def graph_makespan(compute, comm, src, dst) -> float:
                 ready.append(w)
     if done != n:
         raise ValueError("graph_makespan: graph has a cycle")
-    return max(float(sum(compute)), critical)
+    out = max(float(sum(compute)), critical)
+    if axis is not None:
+        per_axis: dict[int, float] = {}
+        for v in range(n):
+            if axis[v] >= 0:
+                per_axis[axis[v]] = per_axis.get(axis[v], 0.0) + comm[v]
+        for c in per_axis.values():
+            out = max(out, c)
+    return out
 
 
 class _MakespanAccum:
     """Collects per-node (compute, comm) costs + dependency edges during a
     strategy evaluation, then evaluates the makespan. Shared by both search
-    evaluators so neither prices a branchy graph as a serial sum."""
+    evaluators so neither prices a branchy graph as a serial sum. Each
+    node's comm is tagged with the ICI axis it occupies so same-axis comm
+    serializes (see graph_makespan)."""
 
     def __init__(self):
         self.compute: list[float] = []
         self.comm: list[float] = []
+        self.axis: list[int] = []
         self.idx: dict[int, int] = {}  # node guid -> task index
+        self._axis_ids: dict[str, int] = {}
 
-    def add(self, guid: int, compute: float, comm: float):
+    def add(self, guid: int, compute: float, comm: float, comm_axes=()):
         self.idx[guid] = len(self.compute)
         self.compute.append(compute)
         self.comm.append(comm)
+        ax = -1
+        for name in comm_axes:
+            ax = self._axis_ids.setdefault(name, len(self._axis_ids))
+            break  # attribute to the first (dominant) axis
+        self.axis.append(ax)
 
     def makespan(self, in_edges) -> float:
         src, dst = [], []
@@ -174,7 +198,8 @@ class _MakespanAccum:
                     dst.append(i)
         if not self.compute:
             return 0.0
-        return graph_makespan(self.compute, self.comm, src, dst)
+        return graph_makespan(self.compute, self.comm, src, dst,
+                              axis=self.axis)
 
 
 class CostModel:

@@ -560,21 +560,25 @@ def evaluate_graph(graph: Graph, mesh, cm: CostModel) -> tuple[float, float]:
                          if node.op_type == OT.OP_FUSED_PARALLEL
                          else [node.op_type])
             comm = 0.0
+            comm_axes = []
             for st, sp in zip(sub_types, sub):
                 if st == OT.OP_COMBINE:
                     ax = _degree_axis(machine, sp.degree)
                     comm += machine.all_gather(local_bytes * sp.degree, ax)
+                    comm_axes.append(ax)
                 elif st == OT.OP_REPARTITION:
                     if pt.shape.total_degree > 1:
                         ax = _degree_axis(machine, sp.degree)
                         comm += machine.all_to_all(local_bytes, ax)
+                        comm_axes.append(ax)
                     # from fully-replicated: local slice, free
                 elif st == OT.OP_REDUCTION:
                     ax = _degree_axis(machine, sp.degree)
                     comm += machine.all_reduce(local_bytes, ax)
+                    comm_axes.append(ax)
                 # Replicate: broadcast of an already-replicated tensor and
                 # Pipeline stage markers are free
-            acc.add(node.guid, 0.0, comm)
+            acc.add(node.guid, 0.0, comm, comm_axes=tuple(comm_axes))
             continue
         in_shapes, in_assigns = [], []
         for pt in node.inputs:
@@ -584,7 +588,8 @@ def evaluate_graph(graph: Graph, mesh, cm: CostModel) -> tuple[float, float]:
             node, [_logical_assignment(pt) for pt in node.outputs],
             dict(node.weight_axes), in_shapes, in_assigns)
         acc.add(node.guid, cmx.forward_time + cmx.backward_time,
-                cmx.sync_time + cmx.comm_time)
+                cmx.sync_time + cmx.comm_time,
+                comm_axes=(AXIS_DATA,) if cmx.sync_time > 0 else ())
         mem += cmx.memory
     return acc.makespan(graph.in_edges), mem
 

@@ -69,7 +69,8 @@ def _dp_assign(ndim, batch_ok=True, last_axes=()):
 
 
 class UnitySearch:
-    def __init__(self, graph, mesh, config, cost_model: CostModel):
+    def __init__(self, graph, mesh, config, cost_model: CostModel,
+                 segment_cache: Optional[dict] = None):
         self.graph = graph
         self.mesh = mesh
         self.config = config
@@ -78,7 +79,14 @@ class UnitySearch:
         self.model_deg = self.axis_sizes.get(AXIS_MODEL, 1)
         self.data_deg = self.axis_sizes.get(AXIS_DATA, 1)
         self.order = graph.topo_order()
-        self._segment_cache: dict = {}
+        # memoized segment costs keyed by (segment structure hash, boundary
+        # configs, λ) — the SearchHelper::graph_cost memo (graph.cc:1586).
+        # Shareable across UnitySearch instances (the joint search reuses
+        # one cache across rewritten candidate graphs, so unchanged
+        # segments cost nothing to re-evaluate).
+        self._segment_cache: dict = (segment_cache if segment_cache
+                                     is not None else {})
+        self.cache_hits = 0
 
     # ---------------------------------------------------- candidate configs
 
@@ -153,14 +161,16 @@ class UnitySearch:
 
     # ---------------------------------------------------- strategy evaluation
 
-    def evaluate(self, choice: dict) -> tuple[float, float]:
+    def evaluate(self, choice: dict, only=None) -> tuple[float, float]:
         """(makespan seconds, peak per-chip memory bytes) of a full
         assignment {guid -> NodeConfig} — the simulate_runtime analog:
         per-node compute serializes across the chip set while communication
         overlaps other ops' compute, so the result is
         max(sum compute, critical path of compute+comm) via graph_makespan
         (native ff_eval_makespan), not an additive sum — concurrent
-        branches (DLRM towers) are priced at max(paths)."""
+        branches (DLRM towers) are priced at max(paths). `only` restricts
+        accumulation to a guid subset (segment costing): configs outside it
+        still feed reshard classification but don't contribute cost."""
         acc = _MakespanAccum()
         mem = 0.0
         for node in self.order:
@@ -168,6 +178,8 @@ class UnitySearch:
                 continue
             cfg = choice.get(node.guid)
             if cfg is None:
+                continue
+            if only is not None and node.guid not in only:
                 continue
             in_shapes, in_assigns, reshard = [], [], 0.0
             for e in sorted(self.graph.in_edges[node.guid],
@@ -201,9 +213,13 @@ class UnitySearch:
                           if not d.is_replica_dim),
                     cfg.out_assign, self.axis_sizes) * dtype_bytes(out_pt.dtype)
                 psum += self.cm.machine.all_reduce(shard_bytes, ax)
+            comm_axes = tuple(cfg.psum_axes)
+            if not comm_axes and cm.sync_time > 0:
+                comm_axes = (AXIS_DATA,)  # gradient allreduce rides `data`
             acc.add(node.guid,
                     cm.forward_time + cm.backward_time,
-                    cm.sync_time + cm.comm_time + reshard + psum)
+                    cm.sync_time + cm.comm_time + reshard + psum,
+                    comm_axes=comm_axes)
             mem += cm.memory
         return acc.makespan(self.graph.in_edges), mem
 
@@ -244,14 +260,45 @@ class UnitySearch:
         return out
 
     def run(self) -> dict:
-        """DP over bottleneck segments + best-first refinement. Returns
-        {guid -> NodeConfig}."""
+        """Memoized sequence DP over bottleneck-node configs — the
+        find_optimal_sequence_graph_time recursion flattened
+        (graph.cc:115-180, 1586-1843): the graph is cut at bottleneck
+        nodes; the DP state is the config of the cut node's tensor; each
+        segment's interior is optimized once per (in-config, out-config)
+        boundary pair and memoized by segment *structure*, so repeated
+        transformer blocks (and unchanged segments across rewritten
+        candidate graphs) hit the cache. Best-first refinement afterwards
+        (base_optimize analog). Returns {guid -> NodeConfig}."""
         segments = self._split_segments()
-        choice: dict = {}
-        for seg in segments:
-            choice.update(self._optimize_segment(seg, choice))
-        choice = self._refine(choice)
-        return choice
+        if len(segments) <= 1:
+            choice: dict = {}
+            for seg in segments:
+                choice.update(self._optimize_segment(seg, choice))
+            return self._refine(choice)
+        # dp: {boundary NodeConfig -> (cumulative cost, full choice)}
+        dp: dict = {None: (0.0, {})}
+        prev_bn = None
+        for k, seg in enumerate(segments):
+            bn = seg[-1]
+            last = k == len(segments) - 1
+            # the sink segment's boundary is unconstrained (its configs are
+            # chosen by the interior optimization)
+            out_cfgs = [None] if last else self.node_configs(bn)
+            ndp: dict = {}
+            for in_cfg, (prev_cost, prev_choice) in dp.items():
+                for out_cfg in out_cfgs:
+                    seg_choice, seg_cost = self._segment_cost(
+                        seg, in_cfg, out_cfg, prev_bn)
+                    tot = prev_cost + seg_cost
+                    cur = ndp.get(out_cfg)
+                    if cur is None or tot < cur[0]:
+                        full = dict(prev_choice)
+                        full.update(seg_choice)
+                        ndp[out_cfg] = (tot, full)
+            dp = ndp
+            prev_bn = bn
+        _, best_choice = min(dp.values(), key=lambda t: t[0])
+        return self._refine(best_choice)
 
     def _split_segments(self):
         cuts = {n.guid for n in self.bottlenecks()}
@@ -265,14 +312,68 @@ class UnitySearch:
             segments.append(cur)
         return segments
 
-    def _optimize_segment(self, seg, context: dict) -> dict:
+    def _segment_key(self, seg):
+        """Structural hash of a segment: op types/params/output shapes +
+        internal wiring + external input shapes. Two segments with equal
+        keys have identical cost surfaces, so (key, boundary configs) fully
+        determines the memoized optimum — the reference memoizes graph_cost
+        by (subgraph hash, source/sink MachineViews)."""
+        idx = {n.guid: i for i, n in enumerate(seg)}
+        parts = []
+        for n in seg:
+            edges = []
+            for e in sorted(self.graph.in_edges[n.guid],
+                            key=lambda e: e.dst_idx):
+                src = self.graph.nodes[e.src]
+                if e.src in idx:
+                    edges.append((idx[e.src], e.src_idx, e.dst_idx))
+                else:  # external producer: its shape drives reshard cost
+                    pt = src.outputs[e.src_idx]
+                    edges.append((-1, pt.shape.logical_shape,
+                                  pt.dtype, e.dst_idx))
+            parts.append((n.op_type, repr(n.params),
+                          tuple(pt.shape.logical_shape for pt in n.outputs),
+                          tuple(edges)))
+        return hash(tuple(parts))
+
+    def _segment_cost(self, seg, in_cfg, out_cfg, prev_bn):
+        """Memoized optimal (choice, cost) of one segment under fixed
+        boundary configs. Bottleneck cuts guarantee every edge crossing the
+        cut leaves the bottleneck node itself, so (in_cfg, out_cfg) is the
+        complete external context."""
+        lam = getattr(self, "_lambda", 0.0)
+        key = (self._segment_key(seg), in_cfg, out_cfg, lam)
+        hit = self._segment_cache.get(key)
+        if hit is not None:
+            self.cache_hits += 1
+            cfgs, cost = hit
+            return {n.guid: c for n, c in zip(seg, cfgs)}, cost
+        context = ({prev_bn.guid: in_cfg}
+                   if prev_bn is not None and in_cfg is not None else {})
+        pinned = {seg[-1].guid: out_cfg} if out_cfg is not None else {}
+        choice = self._optimize_segment(seg, context, pinned)
+        only = {n.guid for n in seg}
+        full = dict(context)
+        full.update(choice)
+        cost, mem = self.evaluate(full, only=only)
+        cost = self._memory_penalized(cost, mem)
+        self._segment_cache[key] = (tuple(choice[n.guid] for n in seg), cost)
+        return choice, cost
+
+    def _optimize_segment(self, seg, context: dict,
+                          pinned: Optional[dict] = None) -> dict:
         """Jointly enumerate configs for interesting nodes in the segment
-        (the nonsequence exhaustive split); pass-through nodes follow."""
+        (the nonsequence exhaustive split); pass-through nodes follow.
+        `pinned` fixes boundary-node configs chosen by the outer DP."""
+        pinned = pinned or {}
         interesting = [n for n in seg
-                       if len(self.node_configs(n)) > 1]
+                       if n.guid not in pinned
+                       and len(self.node_configs(n)) > 1]
         base = {n.guid: self.node_configs(n)[0] for n in seg}
+        base.update(pinned)
         if not interesting:
             return base
+        only = {n.guid for n in seg}
         # cap the joint enumeration (reference caps via threshold + DP)
         cap = 6
         heads, tail = interesting[:cap], interesting[cap:]
@@ -283,9 +384,10 @@ class UnitySearch:
             for n, cfg in zip(heads, combo):
                 cand[n.guid] = cfg
             self._propagate_feature_chains(seg, cand)
+            cand.update(pinned)
             full = dict(context)
             full.update(cand)
-            cost, mem = self.evaluate(full)
+            cost, mem = self.evaluate(full, only=only)
             cost = self._memory_penalized(cost, mem)
             if best_cost is None or cost < best_cost:
                 best, best_cost = cand, cost
@@ -297,7 +399,7 @@ class UnitySearch:
                 cand[n.guid] = cfg
                 full = dict(context)
                 full.update(cand)
-                cost, mem = self.evaluate(full)
+                cost, mem = self.evaluate(full, only=only)
                 cost = self._memory_penalized(cost, mem)
                 if cur_cost is None or cost < cur_cost:
                     cur_best, cur_cost = cand, cost

@@ -175,4 +175,32 @@ double ff_eval_makespan(int32_t n, const double* compute, const double* comm,
   return std::max(total_compute, critical);
 }
 
+// Resource-aware variant: the TPU recast of the reference's machine-resource
+// (horizontal) splits (graph.cc:267-321). On TPU the contended resource of
+// concurrent branches is not a chip subset (SPMD runs every op on all chips)
+// but the ICI axis a collective rides: two branches all-reducing over the
+// SAME mesh axis serialize on its links, while collectives on disjoint axes
+// genuinely overlap. axis[i] in [0, n_axes) names the ICI axis of node i's
+// communication (-1 = none / axis-free), adding per-axis link-occupancy
+// lower bounds:
+//   makespan = max( sum_i compute[i],
+//                   max_a sum_{axis[i]==a} comm[i],
+//                   critical path of compute+comm )
+// Returns -1.0 on cycle.
+double ff_eval_makespan_axes(int32_t n, const double* compute,
+                             const double* comm, const int32_t* axis,
+                             int32_t m, const int32_t* src,
+                             const int32_t* dst) {
+  double base = ff_eval_makespan(n, compute, comm, m, src, dst);
+  if (base < 0) return base;
+  std::vector<double> axis_comm;
+  for (int32_t i = 0; i < n; i++) {
+    if (axis[i] < 0) continue;
+    if ((size_t)axis[i] >= axis_comm.size()) axis_comm.resize(axis[i] + 1, 0.0);
+    axis_comm[axis[i]] += comm[i];
+  }
+  for (double c : axis_comm) base = std::max(base, c);
+  return base;
+}
+
 }  // extern "C"
