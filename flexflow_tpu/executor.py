@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .config import FFConfig
 from .fftype import CompMode, DataType, LossType, OperatorType as OT, dtype_to_jnp
 from .initializer import initializer_by_name
-from .loss import loss_value
+from .loss import loss_terms
 from .metrics import Metrics
 from .ops.base import OpContext
 from .optimizer import Optimizer
@@ -102,19 +102,21 @@ class Executor:
     def make_loss_fn(self, state, x_inputs, labels, rng):
         """Shared mixed-precision loss closure for the fused train step and
         the granular FFModel.backward: bf16 compute casts on params/inputs
-        (state is passed uncast — ops own their fp32-statistics handling),
-        fp32 logits into the loss."""
+        (state is passed uncast — ops own their fp32-statistics handling).
+        Logits stay in the compute dtype — the loss reduces them with f32
+        accumulation internally (loss.py), so no logits-sized f32 tensor is
+        materialized. aux carries (logits, new_state, ce_sum): ce_sum is the
+        reusable sparse-CE sum for Metrics (None for non-SCCE losses)."""
         xc = self._cast_compute(x_inputs)
 
         def loss_fn(p):
             logits, new_state, aux = self._apply(
                 self._cast_compute(p), state, xc, training=True, rng=rng
             )
-            logits = logits.astype(jnp.float32)
-            l = loss_value(
+            l, ce_sum = loss_terms(
                 self.loss_type, logits, labels, self.last_op_is_softmax
             )
-            return l + aux, (logits, new_state)
+            return l + aux, (logits, new_state, ce_sum)
 
         return loss_fn
 
@@ -228,14 +230,17 @@ class Executor:
         def train_step(params, state, opt_slots, step, counters, rng, batch):
             x_inputs, labels = batch
             loss_fn = self.make_loss_fn(state, x_inputs, labels, rng)
-            (lval, (logits, new_state)), grads = jax.value_and_grad(
+            (lval, (logits, new_state, ce_sum)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
             new_state = self._restore_state_dtypes(new_state)
             new_params, new_slots = self.optimizer.update(
                 grads, params, opt_slots, step
             )
-            counters = self.metrics.compute(counters, logits, labels)
+            counters = self.metrics.compute(
+                counters, logits, labels,
+                from_logits=not self.last_op_is_softmax, scce_sum=ce_sum,
+            )
             return new_params, new_state, new_slots, step + 1, counters, lval
 
         self._train_step = jax.jit(train_step, donate_argnums=_donate_argnums((0, 1, 2, 3, 4)))
@@ -249,7 +254,8 @@ class Executor:
                 self._cast_compute(x_inputs), training=False, rng=None,
             )
             counters = self.metrics.compute(
-                counters, logits.astype(jnp.float32), labels
+                counters, logits, labels,
+                from_logits=not self.last_op_is_softmax,
             )
             return counters
 

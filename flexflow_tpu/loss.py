@@ -22,9 +22,49 @@ from .fftype import LossType
 _EPS = 1e-8
 
 
-def loss_value(loss_type: LossType, logits, labels, last_op_is_softmax: bool):
-    """Scalar loss. `logits` is the final op output — probabilities if the
-    graph ends in softmax (the reference's convention for CCE losses)."""
+@jax.custom_vjp
+def _softmax_xent_sum(logits2d, labels1d):
+    """Sum over rows of (logsumexp(row) - row[label]), f32.
+
+    Fused softmax-cross-entropy from logits: the forward reduces the (possibly
+    bf16) logits with f32 accumulation without materializing an f32 copy, and
+    the hand-written backward emits (softmax - onehot)·g directly in the
+    logits dtype — so nothing logits-sized ever hits HBM in f32. This is the
+    TPU analog of the reference's fused loss backward kernel
+    (loss_functions.cu:24-50), which likewise writes scaled logit gradients
+    in one pass."""
+    lse = jax.scipy.special.logsumexp(logits2d.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(
+        logits2d, labels1d[:, None], axis=-1
+    )[:, 0].astype(jnp.float32)
+    return jnp.sum(lse - ll)
+
+
+def _softmax_xent_sum_fwd(logits2d, labels1d):
+    lse = jax.scipy.special.logsumexp(logits2d.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(
+        logits2d, labels1d[:, None], axis=-1
+    )[:, 0].astype(jnp.float32)
+    return jnp.sum(lse - ll), (logits2d, labels1d, lse)
+
+
+def _softmax_xent_sum_bwd(res, g):
+    logits2d, labels1d, lse = res
+    # onehot via iota-compare so exp/sub/scale/cast fuse into one pass
+    col = jax.lax.broadcasted_iota(jnp.int32, logits2d.shape, 1)
+    p = jnp.exp(logits2d.astype(jnp.float32) - lse[:, None])
+    d = (p - (col == labels1d[:, None]).astype(jnp.float32)) * g
+    return d.astype(logits2d.dtype), None
+
+
+_softmax_xent_sum.defvjp(_softmax_xent_sum_fwd, _softmax_xent_sum_bwd)
+
+
+def loss_terms(loss_type: LossType, logits, labels, last_op_is_softmax: bool):
+    """(scalar loss, reusable sparse-CE sum or None).
+
+    The CE sum (f32, pre-averaging) is handed to Metrics so the scce counter
+    doesn't re-reduce the full logits tensor a second time per step."""
     lt = LossType(loss_type)
     b = logits.shape[0]
     if lt == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
@@ -32,13 +72,28 @@ def loss_value(loss_type: LossType, logits, labels, last_op_is_softmax: bool):
         # with (b, s, 1) labels), matching the reference kernel's per-sample
         # flattening (loss_functions.cu sparse_categorical_crossentropy)
         num_classes = logits.shape[-1]
-        logp2 = logits.reshape(-1, num_classes)
+        flat = logits.reshape(-1, num_classes)
         lab = labels.reshape(-1).astype(jnp.int32)
         if last_op_is_softmax:
-            logp2 = jnp.log(logp2 + _EPS)
+            logp2 = jnp.log(flat.astype(jnp.float32) + _EPS)
+            ce_sum = -jnp.sum(
+                jnp.take_along_axis(logp2, lab[:, None], axis=-1)
+            )
         else:
-            logp2 = jax.nn.log_softmax(logp2, axis=-1)
-        return -jnp.mean(jnp.take_along_axis(logp2, lab[:, None], axis=-1))
+            ce_sum = _softmax_xent_sum(flat, lab)
+        return ce_sum / flat.shape[0], ce_sum
+    return _loss_value_rest(lt, logits, labels, last_op_is_softmax, b), None
+
+
+def loss_value(loss_type: LossType, logits, labels, last_op_is_softmax: bool):
+    """Scalar loss. `logits` is the final op output — probabilities if the
+    graph ends in softmax (the reference's convention for CCE losses)."""
+    return loss_terms(loss_type, logits, labels, last_op_is_softmax)[0]
+
+
+def _loss_value_rest(lt, logits, labels, last_op_is_softmax, b):
+    # legacy paths reduce in f32; the cast fuses into the reductions
+    logits = logits.astype(jnp.float32)
     if lt == LossType.LOSS_CATEGORICAL_CROSSENTROPY:
         logp = jnp.log(logits + _EPS) if last_op_is_softmax else jax.nn.log_softmax(logits, -1)
         return -jnp.sum(labels * logp) / b
@@ -49,4 +104,4 @@ def loss_value(loss_type: LossType, logits, labels, last_op_is_softmax: bool):
     if lt == LossType.LOSS_IDENTITY:
         # pass-through: gradient of ones/batch (loss_functions.cu identity_loss)
         return jnp.sum(logits) / b
-    raise ValueError(f"unknown loss {loss_type}")
+    raise ValueError(f"unknown loss {lt}")

@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 
 import math
 
+import jax
 import jax.numpy as jnp
 
 from .fftype import LossType, MetricsType
@@ -61,12 +62,18 @@ class Metrics:
             )
         }
 
-    def compute(self, counters, logits, labels):
+    def compute(self, counters, logits, labels, *, from_logits=False,
+                scce_sum=None):
         """One batch's contribution (metrics_functions.cu update kernels).
 
         Classification metrics treat every leading position as a sample —
         (b, classes) classifiers and (b, s, vocab) LMs both work (matching
-        loss.py's sparse-CE flattening); sample count follows suit."""
+        loss.py's sparse-CE flattening); sample count follows suit.
+
+        `from_logits` says the final op is not a softmax, so CE metrics go
+        through log_softmax instead of log(probs). `scce_sum`, when given, is
+        the loss pass's already-reduced CE sum (loss.loss_terms) — reusing it
+        avoids a second full reduction over the logits tensor per step."""
         classification = (
             self.measure_accuracy
             or self.measure_sparse_categorical_crossentropy
@@ -88,19 +95,30 @@ class Metrics:
                 (pred == sparse).astype(jnp.float32)
             )
         if self.measure_sparse_categorical_crossentropy:
-            logp = jnp.log(flat + eps)
-            new["sparse_cce_loss"] = counters["sparse_cce_loss"] - jnp.sum(
-                jnp.take_along_axis(logp, sparse[:, None], axis=-1)
-            )
+            if scce_sum is not None:
+                contrib = scce_sum
+            else:
+                f32 = flat.astype(jnp.float32)
+                logp = (jax.nn.log_softmax(f32, axis=-1) if from_logits
+                        else jnp.log(f32 + eps))
+                contrib = -jnp.sum(
+                    jnp.take_along_axis(logp, sparse[:, None], axis=-1)
+                )
+            new["sparse_cce_loss"] = counters["sparse_cce_loss"] + contrib
         if self.measure_categorical_crossentropy:
-            new["cce_loss"] = counters["cce_loss"] - jnp.sum(
-                labels * jnp.log(logits + eps)
-            )
+            f32 = logits.astype(jnp.float32)
+            logp = (jax.nn.log_softmax(f32, axis=-1) if from_logits
+                    else jnp.log(f32 + eps))
+            new["cce_loss"] = counters["cce_loss"] - jnp.sum(labels * logp)
+        if (self.measure_mean_squared_error or self.measure_root_mean_squared_error
+                or self.measure_mean_absolute_error):
+            # reduce in f32: the bf16 compute path hands bf16 logits in, and
+            # an 8-bit-mantissa accumulation over the batch is garbage
+            err = logits.astype(jnp.float32) - labels.astype(jnp.float32)
         if self.measure_mean_squared_error or self.measure_root_mean_squared_error:
-            se = jnp.sum((logits - labels) ** 2)
-            new["mse_loss"] = counters["mse_loss"] + se
+            new["mse_loss"] = counters["mse_loss"] + jnp.sum(err ** 2)
         if self.measure_mean_absolute_error:
-            new["mae_loss"] = counters["mae_loss"] + jnp.sum(jnp.abs(logits - labels))
+            new["mae_loss"] = counters["mae_loss"] + jnp.sum(jnp.abs(err))
         return new
 
 

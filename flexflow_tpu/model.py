@@ -846,13 +846,19 @@ class FFModel:
         inner = self.executor.make_loss_fn(self._state, xs, labels, self._rng)
 
         def loss_fn(p):
-            l, (logits, _) = inner(p)
-            return l, logits
+            l, (logits, _, ce_sum) = inner(p)
+            return l, (logits, ce_sum)
 
-        (lval, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(self._params)
+        (lval, (logits, ce_sum)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(self._params)
         self._grads = grads
         self._cached_logits = logits
-        self._counters = self.metrics.compute(self._counters, logits, labels)
+        self._counters = self.metrics.compute(
+            self._counters, logits, labels,
+            from_logits=not self.executor.last_op_is_softmax,
+            scce_sum=ce_sum,
+        )
         return lval
 
     def update(self):

@@ -1,0 +1,96 @@
+"""Ablation harness around bench.py's model to locate step-time costs.
+
+Knobs via env:
+  ABL_ATTN=flash|xla    attention impl (default flash)
+  ABL_BATCH=N           batch size (default 8)
+  ABL_NO_METRICS=1      skip metrics.compute in the step
+  ABL_NO_OPT=1          skip optimizer update (grads still computed)
+  ABL_FWD_ONLY=1        forward+loss only (no grad)
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    sys.argv = [sys.argv[0]]
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.fftype import DataType
+    from flexflow_tpu.models import TransformerLMConfig, build_transformer_lm
+
+    attn = os.environ.get("ABL_ATTN", "flash")
+    batch = int(os.environ.get("ABL_BATCH", "8"))
+    cfg = TransformerLMConfig(
+        vocab_size=32000, hidden_size=1024, num_heads=16, num_layers=12,
+        sequence_length=512, attention_impl=attn,
+    )
+    steps, warmup = 20, 3
+
+    config = FFConfig()
+    config.batch_size = batch
+    config.computation_dtype = DataType.DT_BFLOAT16
+    ff = FFModel(config)
+    build_transformer_lm(ff, cfg, batch_size=batch)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    ex = ff.executor
+
+    if os.environ.get("ABL_NO_METRICS"):
+        ex.metrics.compute = lambda counters, logits, labels, **kw: counters
+    if os.environ.get("ABL_NO_OPT"):
+        ex.optimizer.update = lambda grads, params, slots, step: (params, slots)
+
+    if os.environ.get("ABL_FWD_ONLY"):
+        import jax.numpy as jnp
+
+        def fwd_step(params, state, opt_slots, step, counters, rng, batch):
+            x_inputs, labels = batch
+            loss_fn = ex.make_loss_fn(state, x_inputs, labels, rng)
+            lval, (logits, new_state, ce_sum) = loss_fn(params)
+            return params, state, opt_slots, step + 1, counters, lval
+
+        step_fn = jax.jit(fwd_step)
+    else:
+        step_fn = ex.build_train_step()
+
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, cfg.vocab_size,
+                      (batch, cfg.sequence_length)).astype(np.int32)
+    pos = np.tile(np.arange(cfg.sequence_length, dtype=np.int32), (batch, 1))
+    labels = rs.randint(0, cfg.vocab_size,
+                        (batch, cfg.sequence_length, 1)).astype(np.int32)
+    batch_data = ff._make_batch({"tokens": toks, "positions": pos}, labels)
+
+    state = (ff._params, ff._state, ff._opt_slots, ff._step, ff._counters)
+    rng = jax.random.key(0)
+
+    def run(n):
+        nonlocal state, rng
+        for _ in range(n):
+            rng, sub = jax.random.split(rng)
+            p, s, o, st, c, _ = step_fn(*state, sub, batch_data)
+            state = (p, s, o, st, c)
+        jax.block_until_ready(state[0])
+
+    run(warmup)
+    t0 = time.perf_counter()
+    run(steps)
+    dt = time.perf_counter() - t0
+    tok_s = steps * batch * cfg.sequence_length / dt
+    print(json.dumps({
+        "attn": attn, "batch": batch,
+        "no_metrics": bool(os.environ.get("ABL_NO_METRICS")),
+        "no_opt": bool(os.environ.get("ABL_NO_OPT")),
+        "fwd_only": bool(os.environ.get("ABL_FWD_ONLY")),
+        "ms_per_step": round(dt / steps * 1e3, 3),
+        "tokens_per_sec": round(tok_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
