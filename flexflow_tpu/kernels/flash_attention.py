@@ -404,11 +404,9 @@ def _bwd_dkv_kernel(
         i, j, causal=causal, block_q=block_q, block_k=block_k,
         causal_offset=causal_offset, even_k=seq_k % block_k == 0, nj=nj,
     )
-    # the dkv kernel's tail dimension is q, not kv: a ragged q tail needs
-    # the masked path on the last i so mask_q_rows' probability mask exists
-    if seq_q % block_q != 0:
-        needs_mask = jnp.logical_or(needs_mask, i == ni - 1)
-    if causal or seq_q % block_q != 0 or seq_k % block_k != 0:
+    # (a ragged q tail needs no masked-path forcing here: _bwd_tile joins
+    # q-row validity into the probability mask independently of `masked`)
+    if causal or seq_k % block_k != 0:
         pl.when(jnp.logical_and(live, needs_mask))(lambda: step(True))
         pl.when(jnp.logical_and(live, jnp.logical_not(needs_mask)))(
             lambda: step(False))

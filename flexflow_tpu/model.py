@@ -611,26 +611,32 @@ class FFModel:
         # --- mesh + strategy
         self.mesh = build_mesh(self.config.mesh_shape())
         used_substitutions = False
-        use_subst_search = (
+        n_devices = 1
+        for v in self.mesh.shape.values():
+            n_devices *= v
+        do_search = (
             self._strategy is None
             and not self.config.only_data_parallel
-            and (self.config.enable_substitutions
-                 or self.config.substitution_json_path)
-        )
-        use_config_search = (
-            not use_subst_search
-            and self._strategy is None
-            and not self.config.only_data_parallel
-            and self.mesh.shape.get(AXIS_MODEL, 1) > 1
+            and n_devices > 1
             and (
                 self.config.search_budget > 0
                 or self.config.enable_parameter_parallel
                 or self.config.enable_attribute_parallel
+                or self.config.enable_substitutions
+                or bool(self.config.substitution_json_path)
             )
         )
-        cost_model = None
-        if use_subst_search or use_config_search:
+        if do_search:
+            # ONE joint Unity search (GRAPH_OPTIMIZE_TASK analog): GraphXfer
+            # rewrites and per-node placements optimized together — every
+            # rewritten candidate is costed by the placement DP
+            # (substitution.cc:2229-2311 + graph.cc:1742-1843). The winning
+            # graph (possibly rewritten, with explicit parallel ops) replaces
+            # the layer-built one and arrives with every tensor's mesh axes +
+            # weight shardings materialized; the searched placements are also
+            # kept as a Strategy for --export-strategy.
             from .search.cost_model import CostModel
+            from .search.joint import joint_graph_optimize
             from .search.machine_model import machine_model_for_mesh
 
             cost_model = CostModel(machine_model_for_mesh(self.mesh))
@@ -640,25 +646,13 @@ class FFModel:
                 # (Simulator::measure_operator_cost, model.cu:38-75)
                 cost_model.calibrate_graph(
                     g, top_k=self.config.search_calibrate)
-        if use_subst_search:
-            # substitution half of Unity: explore GraphXfer-rewritten PCGs
-            # that insert explicit parallel ops (substitution.cc:1898+);
-            # the winning graph replaces the layer-built one and arrives
-            # with mesh axes + weight shardings already emitted
-            from .search.substitution import graph_optimize
-
             tensor_to_out[self.layers[-1].outputs[0].tensor_guid][0]._is_logits = True
-            g = graph_optimize(g, self.mesh, self.config, cost_model)
+            g, choice, us = joint_graph_optimize(
+                g, self.mesh, self.config, cost_model)
             self.graph = g
+            self._strategy = us.to_strategy(choice).overrides
             used_substitutions = True
-        elif use_config_search:
-            # GRAPH_OPTIMIZE_TASK analog: Unity search over the PCG
-            from .search import search_strategy
-
-            self._strategy = search_strategy(
-                g, self.mesh, self.config, cost_model=cost_model
-            ).overrides
-        if not used_substitutions:
+        else:
             self._assign_strategy()
         if self.config.export_strategy_computation_graph_file:
             from .pcg.graph import export_dot

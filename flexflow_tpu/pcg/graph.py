@@ -149,6 +149,34 @@ class Graph:
         return len(self.nodes)
 
 
+def find_bottlenecks(graph: "Graph", order=None) -> list:
+    """Nodes every source→sink path crosses (the sequence-split points,
+    graph.cc find_bottleneck_node). Uses the native C++ core when available;
+    pure-Python open-edges scan otherwise. Shared by the Unity placement
+    DP's segmenter and the joint search's sequence splitter."""
+    order = order if order is not None else graph.topo_order()
+    from .. import native
+
+    if native.available():
+        idx = {n.guid: i for i, n in enumerate(order)}
+        src, dst = [], []
+        for edges in graph.out_edges.values():
+            for e in edges:
+                src.append(idx[e.src])
+                dst.append(idx[e.dst])
+        mask = native.bottlenecks(len(order), src, dst)
+        if mask is not None:
+            return [n for i, n in enumerate(order) if mask[i]]
+    out = []
+    open_edges = 0
+    for i, n in enumerate(order):
+        open_edges -= len(graph.in_edges[n.guid])
+        if open_edges == 0 and i < len(order) - 1:
+            out.append(n)
+        open_edges += len(graph.out_edges[n.guid])
+    return out
+
+
 def is_expert_buffer(node: OpNode) -> bool:
     """Expert-capacity buffers (outputs of group_by and expert branches) have
     no batch dim; the data-parallel fallback must not shard their dim 0.

@@ -110,6 +110,50 @@ def classify_reshard(shape, from_assign, to_assign, dtype, machine:
     return cost
 
 
+def price_parallel_node(node, machine) -> tuple[float, tuple]:
+    """(comm seconds, ICI axes) of one explicit parallel-op node — the
+    collective its Repartition/Combine/Replicate/Reduction semantics lower
+    to (the reference prices these as partition-copy tasks via the
+    simulator; SURVEY §2.3 maps them to all_to_all/all_gather/psum). A
+    FusedParallelOp pays for each member transform so fused rewrites don't
+    look artificially free."""
+    pt = node.inputs[0]
+    local_bytes = pt.shape.piece_elements() * dtype_bytes(pt.dtype)
+    if node.op_type == OT.OP_FUSED_PARALLEL:
+        subs = [(i.op_type, i) for i in node.params.ops]
+    else:
+        subs = [(node.op_type, node.params)]
+    comm = 0.0
+    comm_axes = []
+
+    def _degree_axis(degree: int) -> str:
+        from ..machine import AXIS_MODEL
+
+        for ax, size in machine.axis_sizes.items():
+            if size == degree:
+                return ax
+        return AXIS_MODEL
+
+    for st, sp in subs:
+        if st == OT.OP_COMBINE:
+            ax = _degree_axis(sp.degree)
+            comm += machine.all_gather(local_bytes * sp.degree, ax)
+            comm_axes.append(ax)
+        elif st == OT.OP_REPARTITION:
+            if pt.shape.total_degree > 1:
+                ax = _degree_axis(sp.degree)
+                comm += machine.all_to_all(local_bytes, ax)
+                comm_axes.append(ax)
+            # from fully-replicated: local slice, free
+        elif st == OT.OP_REDUCTION:
+            ax = _degree_axis(sp.degree)
+            comm += machine.all_reduce(local_bytes, ax)
+            comm_axes.append(ax)
+        # Replicate: broadcast of an already-replicated tensor and Pipeline
+        # stage markers are free
+    return comm, tuple(comm_axes)
+
+
 def graph_makespan(compute, comm, src, dst, axis=None) -> float:
     """Makespan of a strategy's task graph: max(sum of compute, critical
     path of compute+comm) — concurrent branches (DLRM towers, Inception)

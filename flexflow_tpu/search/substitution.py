@@ -42,7 +42,7 @@ from ..parallel.ops import (
 )
 from ..pcg.graph import Graph, OpNode, is_expert_buffer
 from ..tensor import ParallelDim, ParallelTensor, ParallelTensorShape
-from .cost_model import CostModel, dtype_bytes
+from .cost_model import CostModel, dtype_bytes, price_parallel_node
 
 # --------------------------------------------------------------------- pattern
 
@@ -231,18 +231,24 @@ class GraphXfer:
                 else:
                     src_n, src_idx = clone[e.src], e.src_idx
                 new_g.add_edge(src_n, clone[e.dst], src_idx, e.dst_idx)
-        # carry the logits marker through the rewrite so compile can find
-        # the output node after arbitrary rewrites (FFModel sets it on the
-        # original sink before graph_optimize)
+        # carry node markers through the rewrite so compile (logits) and the
+        # joint search's sequence splitter (boundary tokens) can find their
+        # nodes after arbitrary rewrites
         for node in graph.topo_order():
-            if not getattr(node, "_is_logits", False):
+            logits = getattr(node, "_is_logits", False)
+            marks = getattr(node, "_markers", None)
+            if not logits and not marks:
                 continue
             if node.guid in matched:
                 nn = mapped.get((node.guid, 0), (None, 0))[0]
             else:
                 nn = clone[node.guid]
-            if nn is not None:
+            if nn is None:
+                continue
+            if logits:
                 nn._is_logits = True
+            if marks:
+                nn._markers = getattr(nn, "_markers", frozenset()) | marks
         propagate_parallel_state(new_g)
         return new_g
 
@@ -546,39 +552,8 @@ def evaluate_graph(graph: Graph, mesh, cm: CostModel) -> tuple[float, float]:
         if node.op_type in (OT.OP_INPUT, OT.OP_WEIGHT, OT.OP_NOOP):
             continue
         if node.op_type in _PARALLEL:
-            pt = node.inputs[0]
-            local_bytes = (pt.shape.piece_elements()
-                           * dtype_bytes(pt.dtype))
-            # price each (sub-)transform as the collective it lowers to; a
-            # FusedParallelOp pays for its member Reduction/Combine/... the
-            # same as the unfused sequence would (otherwise base_optimize
-            # would prefer fused rewrites purely because they looked free)
-            sub = (node.params.ops
-                   if node.op_type == OT.OP_FUSED_PARALLEL
-                   else [node.params])
-            sub_types = ([i.op_type for i in node.params.ops]
-                         if node.op_type == OT.OP_FUSED_PARALLEL
-                         else [node.op_type])
-            comm = 0.0
-            comm_axes = []
-            for st, sp in zip(sub_types, sub):
-                if st == OT.OP_COMBINE:
-                    ax = _degree_axis(machine, sp.degree)
-                    comm += machine.all_gather(local_bytes * sp.degree, ax)
-                    comm_axes.append(ax)
-                elif st == OT.OP_REPARTITION:
-                    if pt.shape.total_degree > 1:
-                        ax = _degree_axis(machine, sp.degree)
-                        comm += machine.all_to_all(local_bytes, ax)
-                        comm_axes.append(ax)
-                    # from fully-replicated: local slice, free
-                elif st == OT.OP_REDUCTION:
-                    ax = _degree_axis(machine, sp.degree)
-                    comm += machine.all_reduce(local_bytes, ax)
-                    comm_axes.append(ax)
-                # Replicate: broadcast of an already-replicated tensor and
-                # Pipeline stage markers are free
-            acc.add(node.guid, 0.0, comm, comm_axes=tuple(comm_axes))
+            comm, comm_axes = price_parallel_node(node, machine)
+            acc.add(node.guid, 0.0, comm, comm_axes=comm_axes)
             continue
         in_shapes, in_assigns = [], []
         for pt in node.inputs:
@@ -597,13 +572,6 @@ def evaluate_graph(graph: Graph, mesh, cm: CostModel) -> tuple[float, float]:
 def _logical_assignment(pt: ParallelTensor):
     return tuple(a for d, a in zip(pt.shape.dims, pt.axis_assignment)
                  if not d.is_replica_dim)
-
-
-def _degree_axis(machine, degree: int) -> str:
-    for ax, size in machine.axis_sizes.items():
-        if size == degree:
-            return ax
-    return AXIS_MODEL
 
 
 # ------------------------------------------------------------ rule generators
@@ -836,29 +804,23 @@ def load_rule_collection(path: str, mesh) -> list[GraphXfer]:
 
 # -------------------------------------------------------------- base_optimize
 
-def base_optimize(
+def best_first_search(
     graph: Graph,
-    mesh,
-    cm: CostModel,
     xfers: list[GraphXfer],
-    budget: int = 16,
-    alpha: float = 1.2,
-    hbm_cap: Optional[float] = None,
-) -> tuple[Graph, float]:
-    """Best-first search over rewritten graphs (reference base_optimize,
-    substitution.cc:2229-2311): a candidate priority queue ordered by cost,
-    budgeted pops, alpha pruning against the incumbent, graph-hash dedup,
-    and per-chip HBM validity (graph.cc is_valid_strategy)."""
-
-    def cost_of(g: Graph) -> float:
-        t, mem = evaluate_graph(g, mesh, cm)
-        cap = hbm_cap if hbm_cap is not None else cm.machine.chip.hbm_bytes
-        if mem > cap:
-            t *= 1.0 + 10.0 * (mem - cap) / cap
-        return t
-
+    cost_fn,
+    budget: int,
+    alpha: float,
+):
+    """The base_optimize loop (reference substitution.cc:2229-2311) with the
+    candidate evaluator injected: a priority queue of rewritten graphs
+    ordered by cost, budgeted pops, alpha pruning against the incumbent, and
+    graph-hash dedup. `cost_fn(g) -> (cost, payload)` may raise ValueError
+    to reject a candidate. Returns (best graph, best cost, best payload).
+    Shared by the degree-priced substitution search and the joint search
+    (which prices candidates with the placement DP)."""
     counter = itertools.count()
-    best_g, best_cost = graph, cost_of(graph)
+    best_cost, best_payload = cost_fn(graph)
+    best_g = graph
     pq: list = [(best_cost, next(counter), graph)]
     seen = {graph.hash()}
     pops = 0
@@ -878,13 +840,39 @@ def base_optimize(
                     continue
                 seen.add(h)
                 try:
-                    nc = cost_of(ng)
+                    nc, npayload = cost_fn(ng)
                 except ValueError:
                     continue
                 if nc < best_cost:
-                    best_g, best_cost = ng, nc
+                    best_g, best_cost, best_payload = ng, nc, npayload
                 if nc < best_cost * alpha:
                     heapq.heappush(pq, (nc, next(counter), ng))
+    return best_g, best_cost, best_payload
+
+
+def base_optimize(
+    graph: Graph,
+    mesh,
+    cm: CostModel,
+    xfers: list[GraphXfer],
+    budget: int = 16,
+    alpha: float = 1.2,
+    hbm_cap: Optional[float] = None,
+) -> tuple[Graph, float]:
+    """Substitution-only search: candidates priced through the fixed
+    degree-derived axis assignment (evaluate_graph) with per-chip HBM
+    validity (graph.cc is_valid_strategy). The joint search (search/joint.py)
+    prices the same candidates with the full placement DP instead."""
+
+    def cost_of(g: Graph):
+        t, mem = evaluate_graph(g, mesh, cm)
+        cap = hbm_cap if hbm_cap is not None else cm.machine.chip.hbm_bytes
+        if mem > cap:
+            t *= 1.0 + 10.0 * (mem - cap) / cap
+        return t, None
+
+    best_g, best_cost, _ = best_first_search(graph, xfers, cost_of,
+                                             budget, alpha)
     assign_axes_from_degrees(best_g, mesh)
     return best_g, best_cost
 

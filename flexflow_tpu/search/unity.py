@@ -44,6 +44,7 @@ from .cost_model import (
     _spec_to_assignment,
     classify_reshard,
     dtype_bytes,
+    price_parallel_node,
 )
 from .machine_model import TPUMachineModel
 
@@ -52,11 +53,15 @@ from .machine_model import TPUMachineModel
 class NodeConfig:
     """One parallelization choice for a node (the MachineView analog)."""
 
-    name: str  # dp | tp_col | tp_row | tp_attn | ep | replicated
+    name: str  # dp | tp_col | tp_row | tp_attn | ep | feat | xfer | xfer_comm
     out_assign: tuple        # output axis assignment
     weight_specs: tuple = () # ((weight_name, PartitionSpec), ...)
     # extra collective cost this config implies (e.g. row-parallel psum)
     psum_axes: tuple = ()
+    # rewrite-pinned configs (joint search) carry the degree-derived input
+    # assignments the rewritten node consumes, so reshard at the boundary
+    # between searched and pinned regions is priced correctly
+    in_assigns: Optional[tuple] = None
 
 
 def _dp_assign(ndim, batch_ok=True, last_axes=()):
@@ -70,7 +75,8 @@ def _dp_assign(ndim, batch_ok=True, last_axes=()):
 
 class UnitySearch:
     def __init__(self, graph, mesh, config, cost_model: CostModel,
-                 segment_cache: Optional[dict] = None):
+                 segment_cache: Optional[dict] = None,
+                 pinned: Optional[dict] = None, refine: bool = True):
         self.graph = graph
         self.mesh = mesh
         self.config = config
@@ -79,6 +85,12 @@ class UnitySearch:
         self.model_deg = self.axis_sizes.get(AXIS_MODEL, 1)
         self.data_deg = self.axis_sizes.get(AXIS_DATA, 1)
         self.order = graph.topo_order()
+        # {guid -> NodeConfig} fixed by a substitution rewrite (joint
+        # search): the placement DP searches only the remaining free nodes
+        self.pinned = pinned or {}
+        # refinement can be disabled for inner joint-search evaluations
+        # (only the winning candidate is refined)
+        self.refine = refine
         # memoized segment costs keyed by (segment structure hash, boundary
         # configs, λ) — the SearchHelper::graph_cost memo (graph.cc:1586).
         # Shareable across UnitySearch instances (the joint search reuses
@@ -92,6 +104,9 @@ class UnitySearch:
 
     def node_configs(self, node) -> list[NodeConfig]:
         """Candidate parallelizations (substitution families)."""
+        pin = self.pinned.get(node.guid)
+        if pin is not None:
+            return [pin]
         ndim = len(node.outputs[0].shape.dims) if node.outputs else 0
         batch_ok = (ndim > 0 and node.outputs and
                     node.outputs[0].shape.dims[0].size % max(1, self.data_deg) == 0
@@ -181,6 +196,28 @@ class UnitySearch:
                 continue
             if only is not None and node.guid not in only:
                 continue
+            if node.op_type in _PARALLEL_OPS:
+                # explicit parallel-op node (joint search over rewritten
+                # graphs): zero compute, collective comm (SURVEY §2.3);
+                # a mismatched free producer additionally pays the reshard
+                # into the degree-derived input placement
+                comm, comm_axes = price_parallel_node(node, self.cm.machine)
+                if cfg.in_assigns:
+                    for e in sorted(self.graph.in_edges[node.guid],
+                                    key=lambda e: e.dst_idx):
+                        src = self.graph.nodes[e.src]
+                        src_cfg = choice.get(src.guid)
+                        if src_cfg is None or e.dst_idx >= len(cfg.in_assigns):
+                            continue
+                        pt = src.outputs[e.src_idx]
+                        shape = tuple(d.size for d in pt.shape.dims
+                                      if not d.is_replica_dim)
+                        comm += classify_reshard(
+                            shape, src_cfg.out_assign,
+                            cfg.in_assigns[e.dst_idx], pt.dtype,
+                            self.cm.machine)
+                acc.add(node.guid, 0.0, comm, comm_axes=comm_axes)
+                continue
             in_shapes, in_assigns, reshard = [], [], 0.0
             for e in sorted(self.graph.in_edges[node.guid],
                             key=lambda e: e.dst_idx):
@@ -225,6 +262,10 @@ class UnitySearch:
 
     def _expected_input(self, node, cfg, dst_idx, ndim):
         """The input spec a config consumes (None = producer's choice OK)."""
+        if cfg.in_assigns is not None:  # rewrite-pinned: degree-derived
+            if dst_idx < len(cfg.in_assigns):
+                return cfg.in_assigns[dst_idx]
+            return None
         if cfg.name == "tp_row" and dst_idx == 0:
             return _dp_assign(ndim, True, last_axes=(AXIS_MODEL,))
         if cfg.name in ("dp", "tp_col", "tp_attn", "ep") and dst_idx == 0:
@@ -235,29 +276,10 @@ class UnitySearch:
 
     def bottlenecks(self) -> list:
         """Nodes every source→sink path crosses (the sequence-split points,
-        graph.cc find_bottleneck_node). Uses the native C++ core when
-        available; pure-Python fallback otherwise."""
-        order = [n for n in self.order]
-        from .. import native
+        graph.cc find_bottleneck_node)."""
+        from ..pcg.graph import find_bottlenecks
 
-        if native.available():
-            idx = {n.guid: i for i, n in enumerate(order)}
-            src, dst = [], []
-            for edges in self.graph.out_edges.values():
-                for e in edges:
-                    src.append(idx[e.src])
-                    dst.append(idx[e.dst])
-            mask = native.bottlenecks(len(order), src, dst)
-            if mask is not None:
-                return [n for i, n in enumerate(order) if mask[i]]
-        out = []
-        open_edges = 0
-        for i, n in enumerate(order):
-            open_edges -= len(self.graph.in_edges[n.guid])
-            if open_edges == 0 and i < len(order) - 1:
-                out.append(n)
-            open_edges += len(self.graph.out_edges[n.guid])
-        return out
+        return find_bottlenecks(self.graph, self.order)
 
     def run(self) -> dict:
         """Memoized sequence DP over bottleneck-node configs — the
@@ -274,7 +296,7 @@ class UnitySearch:
             choice: dict = {}
             for seg in segments:
                 choice.update(self._optimize_segment(seg, choice))
-            return self._refine(choice)
+            return self._refine(choice) if self.refine else choice
         # dp: {boundary NodeConfig -> (cumulative cost, full choice)}
         dp: dict = {None: (0.0, {})}
         prev_bn = None
@@ -298,7 +320,7 @@ class UnitySearch:
             dp = ndp
             prev_bn = bn
         _, best_choice = min(dp.values(), key=lambda t: t[0])
-        return self._refine(best_choice)
+        return self._refine(best_choice) if self.refine else best_choice
 
     def _split_segments(self):
         cuts = {n.guid for n in self.bottlenecks()}
@@ -327,12 +349,16 @@ class UnitySearch:
                 src = self.graph.nodes[e.src]
                 if e.src in idx:
                     edges.append((idx[e.src], e.src_idx, e.dst_idx))
-                else:  # external producer: its shape drives reshard cost
+                else:  # external producer: its full PARALLEL shape (degrees
+                    # + replica dims, not just logical sizes) drives both
+                    # reshard cost and any rewrite-pinned configs inside the
+                    # segment, so it must be part of the key — two joint-
+                    # search candidates can agree on logical shapes but
+                    # differ in boundary parallel state
                     pt = src.outputs[e.src_idx]
-                    edges.append((-1, pt.shape.logical_shape,
-                                  pt.dtype, e.dst_idx))
+                    edges.append((-1, repr(pt.shape), e.dst_idx))
             parts.append((n.op_type, repr(n.params),
-                          tuple(pt.shape.logical_shape for pt in n.outputs),
+                          tuple(repr(pt.shape) for pt in n.outputs),
                           tuple(edges)))
         return hash(tuple(parts))
 
@@ -482,7 +508,10 @@ class UnitySearch:
         s = Strategy()
         for node in self.order:
             cfg = choice.get(node.guid)
-            if cfg is None or cfg.name == "dp":
+            # rewrite-pinned configs are already materialized on the graph's
+            # tensors by the joint search (assign_axes_from_degrees); the
+            # Strategy carries only the placement DP's own choices
+            if cfg is None or cfg.name in ("dp", "xfer", "xfer_comm"):
                 continue
             for i in range(len(node.outputs)):
                 s.set_output(node.name, i, cfg.out_assign)
@@ -491,6 +520,12 @@ class UnitySearch:
                 if wname in declared:
                     s.set_weight(node.name, wname, spec)
         return s
+
+
+_PARALLEL_OPS = frozenset({
+    OT.OP_REPARTITION, OT.OP_COMBINE, OT.OP_REPLICATE, OT.OP_REDUCTION,
+    OT.OP_FUSED_PARALLEL, OT.OP_PIPELINE,
+})
 
 
 _FEATURE_ELEMENTWISE = frozenset({
