@@ -2,13 +2,18 @@
 
 The OSDI'22 AE pattern (reference scripts/osdi22ae/bert.sh: run the same
 model twice, with search and with --only-data-parallel, compare throughput).
-Runs on the virtual CPU mesh by default so it works anywhere:
+The searched plan is exported once (--export-strategy analog) and the third
+run REPLAYS it via import without re-searching, demonstrating the
+strategy-file round trip (model.cc:3599-3608). Runs on the virtual CPU mesh
+by default so it works anywhere:
 
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python examples/unity_vs_dp.py --mesh 2,4,1,1 --budget 8
 """
 
+import os
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, ".")
@@ -23,7 +28,7 @@ if "--hidden" in sys.argv:
     del sys.argv[i : i + 2]
 
 
-def run(only_dp: bool):
+def run(only_dp: bool, export_to: str = "", import_from: str = ""):
     import jax
 
     if jax.default_backend() == "cpu":
@@ -34,7 +39,9 @@ def run(only_dp: bool):
 
     config = FFConfig()
     config.only_data_parallel = only_dp
-    if not only_dp and config.search_budget == 0:
+    config.export_strategy_file = export_to
+    config.import_strategy_file = import_from
+    if not only_dp and not import_from and config.search_budget == 0:
         config.search_budget = 8
     batch = config.batch_size
     ff = FFModel(config)
@@ -59,8 +66,12 @@ def run(only_dp: bool):
 
 
 if __name__ == "__main__":
+    plan = os.path.join(tempfile.gettempdir(), "unity_plan.json")
     dp = run(only_dp=True)
-    unity = run(only_dp=False)
-    print(f"DP-only:  {dp:.1f} samples/s")
-    print(f"Unity:    {unity:.1f} samples/s")
+    unity = run(only_dp=False, export_to=plan)
+    replay = run(only_dp=False, import_from=plan)
+    print(f"DP-only:       {dp:.1f} samples/s")
+    print(f"Unity:         {unity:.1f} samples/s")
+    print(f"Unity (replay): {replay:.1f} samples/s  (imported {plan}, "
+          f"no re-search)")
     print(f"speedup:  {unity / dp:.2f}x")

@@ -20,6 +20,13 @@ import jax
 from .fftype import CompMode, DataType
 from .machine import DEFAULT_AXES, MeshShape
 
+# Flags parsed for reference-CLI parity whose mechanics have no TPU analog;
+# passing them warns loudly instead of silently doing nothing.
+_PARITY_ONLY_FLAGS = frozenset({
+    "--simulator-workspace-size", "--segment-size", "--max-num-segments",
+    "--search-overlap-backward-update", "--enable-propagation",
+})
+
 
 @dataclass
 class FFConfig:
@@ -121,6 +128,15 @@ class FFConfig:
                 i += 1
                 return argv[i]
 
+            if a in _PARITY_ONLY_FLAGS:
+                # accepted so reference scripts run unmodified, but loudly:
+                # these knobs configure simulator/runtime mechanics that
+                # have no analog in the TPU recast (XLA owns workspace
+                # sizing; the analytic cost model doesn't segment
+                # transfers; the jitted step already overlaps update comm)
+                print(f"flexflow_tpu: flag {a} accepted for reference CLI "
+                      f"parity but has no effect in this framework",
+                      file=sys.stderr)
             if a in ("-e", "--epochs"):
                 self.epochs = int(val())
             elif a in ("-b", "--batch-size"):

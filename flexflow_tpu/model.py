@@ -611,6 +611,13 @@ class FFModel:
         # --- mesh + strategy
         self.mesh = build_mesh(self.config.mesh_shape())
         used_substitutions = False
+        if self._strategy is None and self.config.import_strategy_file:
+            # replay a previously searched/exported plan instead of
+            # re-searching (--import-strategy, model.cc:3599-3608)
+            from .parallel.strategies import Strategy
+
+            self._strategy = Strategy.load(
+                self.config.import_strategy_file).overrides
         n_devices = 1
         for v in self.mesh.shape.values():
             n_devices *= v
@@ -637,9 +644,18 @@ class FFModel:
             # kept as a Strategy for --export-strategy.
             from .search.cost_model import CostModel
             from .search.joint import joint_graph_optimize
-            from .search.machine_model import machine_model_for_mesh
+            from .search.machine_model import (
+                machine_model_for_mesh,
+                machine_model_from_file,
+            )
 
-            cost_model = CostModel(machine_model_for_mesh(self.mesh))
+            machine = (
+                machine_model_from_file(
+                    self.config.machine_model_file, self.mesh)
+                if self.config.machine_model_file
+                else machine_model_for_mesh(self.mesh)
+            )
+            cost_model = CostModel(machine)
             if self.config.search_calibrate > 0:
                 # measure the dominant ops on the local chip so the search
                 # costs candidates from measurements, not the mfu guess
@@ -654,6 +670,13 @@ class FFModel:
             used_substitutions = True
         else:
             self._assign_strategy()
+        if self.config.export_strategy_file:
+            # persist the plan in effect (searched or imported) for replay
+            # (--export-strategy, model.cc:3599-3608)
+            from .parallel.strategies import Strategy
+
+            Strategy(self._strategy or {}).save(
+                self.config.export_strategy_file)
         if self.config.export_strategy_computation_graph_file:
             from .pcg.graph import export_dot
 

@@ -63,6 +63,63 @@ class Strategy:
     def __bool__(self):
         return bool(self.overrides)
 
+    # -------------------------------------------------- JSON (de)serialization
+    # The --export-strategy / --import-strategy file format
+    # (model.cc:3599-3608 analog; the reference's protobuf strategy file
+    # becomes JSON here). A searched plan can be saved once and replayed
+    # without re-searching — the AE two-run pattern re-uses one search.
+
+    def to_json(self) -> dict:
+        def spec_entry(e):
+            if e is None:
+                return None
+            if isinstance(e, (tuple, list)):
+                return list(e)
+            return e
+
+        out = {"version": 1, "nodes": {}}
+        for name, ov in self.overrides.items():
+            out["nodes"][name] = {
+                "outputs": {
+                    str(idx): [list(axes) for axes in assignment]
+                    for idx, assignment in ov.get("outputs", {}).items()
+                },
+                "weights": {
+                    wname: [spec_entry(spec[i]) for i in range(len(spec))]
+                    for wname, spec in ov.get("weights", {}).items()
+                },
+            }
+        return out
+
+    @staticmethod
+    def from_json(data: dict) -> "Strategy":
+        if data.get("version") != 1:
+            raise ValueError(
+                f"unsupported strategy file version {data.get('version')!r}")
+        s = Strategy()
+        for name, ov in data.get("nodes", {}).items():
+            for idx, assignment in ov.get("outputs", {}).items():
+                s.set_output(name, int(idx),
+                             tuple(tuple(a) for a in assignment))
+            for wname, entries in ov.get("weights", {}).items():
+                s.set_weight(name, wname, PartitionSpec(*[
+                    tuple(e) if isinstance(e, list) else e for e in entries
+                ]))
+        return s
+
+    def save(self, path: str):
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=1, sort_keys=True)
+
+    @staticmethod
+    def load(path: str) -> "Strategy":
+        import json
+
+        with open(path) as f:
+            return Strategy.from_json(json.load(f))
+
 
 def _act_assignment(ndims: int, batch_axes=(AXIS_DATA,), last_axes=()):
     """Assignment for an activation: batch dim over data, last dim optionally

@@ -126,6 +126,48 @@ class TPUMachineModel:
                    bytes_touched / self.chip.hbm_bandwidth)
 
 
+def machine_model_from_file(path: str, mesh) -> TPUMachineModel:
+    """--machine-model-file analog (reference EnhancedMachineModel config,
+    simulator.h:279 + --machine-model-file in model.cc): a JSON description
+    of the machine overriding the detected chip and topology heuristics.
+
+    Format:
+      {"chip": "v5p"                      # name from CHIPS, or an object:
+               | {"name": ..., "peak_flops": ..., "hbm_bandwidth": ...,
+                  "hbm_bytes": ..., "ici_bandwidth": ..., "ici_links": ...,
+                  ["ici_latency", "dcn_bandwidth", "dcn_latency"]},
+       "axis_links": {"data": 2, ...},    # torus links per mesh axis (opt)
+       "dcn_axes": ["dcn"]}               # axes that ride DCN (opt)
+    """
+    import json
+
+    with open(path) as f:
+        data = json.load(f)
+    chip_cfg = data.get("chip", None)
+    if chip_cfg is None:
+        chip = detect_chip()
+    elif isinstance(chip_cfg, str):
+        if chip_cfg not in CHIPS:
+            raise ValueError(
+                f"machine model file {path}: unknown chip {chip_cfg!r}; "
+                f"have {sorted(CHIPS)}")
+        chip = CHIPS[chip_cfg]
+    else:
+        base = CHIPS.get(chip_cfg.get("name", ""), CHIPS["v5p"])
+        fields = {f: chip_cfg.get(f, getattr(base, f))
+                  for f in ("name", "peak_flops", "hbm_bandwidth",
+                            "hbm_bytes", "ici_bandwidth", "ici_links",
+                            "ici_latency", "dcn_bandwidth", "dcn_latency")}
+        chip = ChipSpec(**fields)
+    axis_sizes = dict(mesh.shape) if hasattr(mesh, "shape") else dict(mesh)
+    links = {a: 1 for a in axis_sizes}
+    links.update({a: int(v) for a, v in data.get("axis_links", {}).items()
+                  if a in links})
+    over_dcn = frozenset(a for a in data.get("dcn_axes", ())
+                         if a in axis_sizes)
+    return TPUMachineModel(chip, axis_sizes, links, over_dcn)
+
+
 def machine_model_for_mesh(mesh, chip: ChipSpec | None = None,
                            num_hosts: int = 1) -> TPUMachineModel:
     chip = chip or detect_chip()

@@ -31,7 +31,7 @@ from typing import Any, Callable, Optional
 
 from jax.sharding import PartitionSpec
 
-from ..fftype import ActiMode, OperatorType as OT
+from ..fftype import ActiMode, OperatorType as OT, PARALLEL_OP_TYPES
 from ..machine import AXIS_DATA, AXIS_MODEL
 from ..parallel.ops import (
     CombineParams,
@@ -42,7 +42,7 @@ from ..parallel.ops import (
 )
 from ..pcg.graph import Graph, OpNode, is_expert_buffer
 from ..tensor import ParallelDim, ParallelTensor, ParallelTensorShape
-from .cost_model import CostModel, dtype_bytes, price_parallel_node
+from .cost_model import CostModel, price_parallel_node
 
 # --------------------------------------------------------------------- pattern
 
@@ -275,10 +275,9 @@ _PASSTHROUGH = frozenset({
     OT.OP_RSQRT, OT.OP_POW, OT.OP_LAYERNORM, OT.OP_SOFTMAX, OT.OP_CAST,
 })
 
-_PARALLEL = frozenset({
-    OT.OP_REPARTITION, OT.OP_COMBINE, OT.OP_REPLICATE, OT.OP_REDUCTION,
-    OT.OP_FUSED_PARALLEL, OT.OP_PIPELINE,
-})
+# single source of truth for the parallel-op type set (also used by
+# OpNode.is_parallel_op and UnitySearch.evaluate)
+_PARALLEL = PARALLEL_OP_TYPES
 
 # Ops that commute with summation: f(sum_i x_i) == sum_i f(x_i). Only these
 # may pass a partial-sum replica dim (row-parallel Linear/MHA output)

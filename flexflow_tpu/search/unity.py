@@ -33,7 +33,7 @@ from typing import Optional
 
 from jax.sharding import PartitionSpec
 
-from ..fftype import OperatorType as OT
+from ..fftype import OperatorType as OT, PARALLEL_OP_TYPES as _PARALLEL_OPS
 from ..machine import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
 from ..parallel.strategies import Strategy
 from .cost_model import (
@@ -505,13 +505,17 @@ class UnitySearch:
     # ---------------------------------------------------- emission
 
     def to_strategy(self, choice: dict) -> Strategy:
+        """Choice → exportable Strategy. Rewrite-pinned compute configs
+        ("xfer") are included in their logical-rank form: under GSPMD the
+        same placements expressed as plain per-node specs on the ORIGINAL
+        graph execute identically (the inserted Replicate/Reduction nodes
+        become implicit collectives), so an exported plan replays without
+        the rewritten graph. Parallel-op nodes ("xfer_comm") are therefore
+        skipped — their effect is carried by their neighbors' specs."""
         s = Strategy()
         for node in self.order:
             cfg = choice.get(node.guid)
-            # rewrite-pinned configs are already materialized on the graph's
-            # tensors by the joint search (assign_axes_from_degrees); the
-            # Strategy carries only the placement DP's own choices
-            if cfg is None or cfg.name in ("dp", "xfer", "xfer_comm"):
+            if cfg is None or cfg.name in ("dp", "xfer_comm"):
                 continue
             for i in range(len(node.outputs)):
                 s.set_output(node.name, i, cfg.out_assign)
@@ -520,12 +524,6 @@ class UnitySearch:
                 if wname in declared:
                     s.set_weight(node.name, wname, spec)
         return s
-
-
-_PARALLEL_OPS = frozenset({
-    OT.OP_REPARTITION, OT.OP_COMBINE, OT.OP_REPLICATE, OT.OP_REDUCTION,
-    OT.OP_FUSED_PARALLEL, OT.OP_PIPELINE,
-})
 
 
 _FEATURE_ELEMENTWISE = frozenset({

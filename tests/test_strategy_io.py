@@ -1,0 +1,143 @@
+"""Strategy import/export tests (--export-strategy / --import-strategy,
+reference model.cc:3599-3608 — where the import path was vestigial; here a
+searched plan round-trips and replays without re-searching), plus the
+--machine-model-file loader."""
+
+import json
+import sys
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+
+def test_strategy_json_round_trip():
+    from flexflow_tpu.parallel.strategies import Strategy
+
+    s = Strategy()
+    s.set_output("fc1", 0, (("data",), (), ("model",)))
+    s.set_output("fc1", 1, ((), ("data", "model")))
+    s.set_weight("fc1", "kernel", P(None, "model"))
+    s.set_weight("fc1", "bias", P("model"))
+    s.set_weight("attn", "wo", P(("data", "model"), None))
+
+    s2 = Strategy.from_json(json.loads(json.dumps(s.to_json())))
+    assert s2.overrides["fc1"]["outputs"][0] == (("data",), (), ("model",))
+    assert s2.overrides["fc1"]["outputs"][1] == ((), ("data", "model"))
+    assert s2.overrides["fc1"]["weights"]["kernel"] == P(None, "model")
+    assert s2.overrides["fc1"]["weights"]["bias"] == P("model")
+    assert s2.overrides["attn"]["weights"]["wo"] == P(("data", "model"), None)
+
+
+def test_strategy_file_version_check(tmp_path):
+    from flexflow_tpu.parallel.strategies import Strategy
+
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"version": 99, "nodes": {}}))
+    with pytest.raises(ValueError, match="version"):
+        Strategy.load(str(p))
+
+
+def _build_and_compile(argv, batch=32):
+    sys.argv = ["test"] + list(argv)
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+
+    config = FFConfig()
+    config.batch_size = batch
+    ff = FFModel(config)
+    x = ff.create_tensor((batch, 64))
+    t = ff.dense(x, 256, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 256, ActiMode.AC_MODE_RELU, name="fc2")
+    t = ff.dense(t, 10, name="head")
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    return ff
+
+
+def test_export_then_import_replays_without_search(tmp_path):
+    """Search once with --export-strategy; the second compile imports the
+    plan and must (a) skip the search and (b) end with the same specs."""
+    plan = str(tmp_path / "plan.json")
+    ff1 = _build_and_compile(
+        ["--mesh", "2,4,1,1", "--budget", "6",
+         "--enable-parameter-parallel", "--export-strategy", plan])
+    exported = json.load(open(plan))
+    assert exported["version"] == 1
+
+    # importing must bypass the search entirely
+    import flexflow_tpu.search.joint as joint
+
+    called = {"n": 0}
+    orig = joint.joint_graph_optimize
+
+    def spy(*a, **kw):
+        called["n"] += 1
+        return orig(*a, **kw)
+
+    joint.joint_graph_optimize = spy
+    try:
+        ff2 = _build_and_compile(
+            ["--mesh", "2,4,1,1", "--budget", "6",
+             "--enable-parameter-parallel", "--import-strategy", plan])
+    finally:
+        joint.joint_graph_optimize = orig
+    assert called["n"] == 0, "import-strategy must not re-search"
+
+    # the replayed model carries the same per-node weight specs
+    for node in ff2.graph.topo_order():
+        ov = exported["nodes"].get(node.name)
+        if not ov:
+            continue
+        for wname, entries in ov["weights"].items():
+            got = node.weight_axes.get(wname)
+            assert got is not None, (node.name, wname)
+            want = tuple(tuple(e) if isinstance(e, list) else e
+                         for e in entries)
+            assert tuple(got) == want, (node.name, wname, got, want)
+
+    # and still trains
+    rs = np.random.RandomState(0)
+    c = rs.randn(10, 64) * 3
+    y = rs.randint(0, 10, 512)
+    xs = (c[y] + rs.randn(512, 64)).astype(np.float32)
+    ff2.fit(xs, y.reshape(-1, 1).astype(np.int32), epochs=2)
+    assert ff2.get_perf_metrics().get_accuracy() >= 0.8
+
+
+def test_machine_model_file(tmp_path):
+    from flexflow_tpu.machine import build_mesh, MeshShape
+    from flexflow_tpu.search.machine_model import (
+        CHIPS, machine_model_from_file,
+    )
+
+    mesh = build_mesh(MeshShape((2, 4, 1, 1)))
+    p = tmp_path / "mm.json"
+    p.write_text(json.dumps({
+        "chip": {"name": "v5p", "ici_bandwidth": 2e10},
+        "axis_links": {"model": 2},
+        "dcn_axes": ["data"],
+    }))
+    m = machine_model_from_file(str(p), mesh)
+    assert m.chip.peak_flops == CHIPS["v5p"].peak_flops
+    assert m.chip.ici_bandwidth == 2e10
+    assert m.axis_links["model"] == 2
+    assert "data" in m.axis_over_dcn
+    # DCN axis must be priced slower than the doubled-ICI axis
+    assert m.all_reduce(1e9, "data") > m.all_reduce(1e9, "model")
+
+    p2 = tmp_path / "mm2.json"
+    p2.write_text(json.dumps({"chip": "nope"}))
+    with pytest.raises(ValueError, match="unknown chip"):
+        machine_model_from_file(str(p2), mesh)
+
+
+def test_parity_only_flags_warn(capsys):
+    sys.argv = ["test", "--segment-size", "1024"]
+    from flexflow_tpu import FFConfig
+
+    FFConfig()
+    err = capsys.readouterr().err
+    assert "no effect" in err
