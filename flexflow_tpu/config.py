@@ -101,9 +101,16 @@ class FFConfig:
         self.parse_args(argv)
         if self.workers_per_node == 0:
             try:
-                self.workers_per_node = max(
-                    1, jax.local_device_count() // max(1, self.num_nodes)
-                )
+                if jax.process_count() > 1:
+                    # multi-controller: local_device_count is already the
+                    # per-host chip count
+                    self.workers_per_node = max(1, jax.local_device_count())
+                else:
+                    # single process (incl. virtual multi-host meshes):
+                    # divide the one process's devices across the nodes
+                    self.workers_per_node = max(
+                        1, jax.local_device_count() // max(1, self.num_nodes)
+                    )
             except Exception:
                 self.workers_per_node = 1
 
@@ -112,8 +119,25 @@ class FFConfig:
         return self.num_nodes * self.workers_per_node
 
     def mesh_shape(self) -> MeshShape:
+        from .machine import MULTIHOST_AXES
+
         if self.mesh_axis_sizes is not None:
-            return MeshShape(tuple(self.mesh_axis_sizes), self.mesh_axis_names)
+            sizes = tuple(self.mesh_axis_sizes)
+            names = self.mesh_axis_names
+            if (len(sizes) == len(MULTIHOST_AXES)
+                    and names == DEFAULT_AXES):
+                # --mesh dcn,data,model,pipe,seq (5 entries): explicit
+                # multi-host mesh with a leading DCN axis
+                names = MULTIHOST_AXES
+            elif self.num_nodes > 1 and len(sizes) == len(names):
+                # --nodes N with a single-slice mesh: prepend the DCN axis
+                sizes = (self.num_nodes,) + sizes
+                names = MULTIHOST_AXES
+            return MeshShape(sizes, names)
+        if self.num_nodes > 1:
+            sizes = (self.num_nodes, self.workers_per_node) + (1,) * (
+                len(MULTIHOST_AXES) - 2)
+            return MeshShape(sizes, MULTIHOST_AXES)
         sizes = [self.num_devices] + [1] * (len(self.mesh_axis_names) - 1)
         return MeshShape(tuple(sizes), self.mesh_axis_names)
 

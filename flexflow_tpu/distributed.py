@@ -1,0 +1,95 @@
+"""Multi-host (multi-controller) support.
+
+Reference: FlexFlow runs multi-node via one Legion process per node under
+mpirun, with the top-level task control-replicated so every node executes the
+same program (src/mapper/mapper.cc:291-306, MULTI-NODE.md) and the strategy
+search pinned to GPU0 with its result serialized to all nodes
+(GRAPH_OPTIMIZE_TASK → deserialize, model.cc:2830-2872).
+
+TPU recast: multi-controller JAX. `initialize()` wraps
+`jax.distributed.initialize` (the mpirun/gasnet bootstrap analog); after it,
+`jax.devices()` spans all hosts and one global Mesh with a leading `dcn`
+axis (machine.MULTIHOST_AXES) covers the fleet — collectives on `dcn` ride
+the data-center network, inboard axes stay on ICI. The Unity search runs on
+process 0 only and the winning plan is broadcast as a serialized Strategy
+(`run_search_on_host0`), mirroring the reference's search-on-GPU0 +
+serialize pattern; every process then applies the identical plan, keeping
+the SPMD programs in lockstep.
+
+Launch recipe (the MULTI-NODE.md analog): see MULTIHOST.md at the repo root.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+):
+    """Bootstrap multi-controller JAX (the mpirun + GASNet-Ex bootstrap
+    analog). On TPU pods all arguments are discovered from the environment;
+    on CPU/GPU fleets pass them explicitly. Safe to call once per process,
+    before any other JAX use."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def broadcast_json(payload: Optional[dict], max_bytes: int = 1 << 20) -> dict:
+    """Broadcast a JSON-serializable dict from process 0 to all processes
+    (the strategy-serialization hop of GRAPH_OPTIMIZE_TASK). Single-process
+    runs return the payload unchanged. The payload is framed as
+    [length u32][utf-8 bytes][zero padding] in a fixed-size u8 buffer so
+    every process contributes an identically-shaped array."""
+    if jax.process_count() <= 1:
+        assert payload is not None
+        return payload
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(max_bytes, dtype=np.uint8)
+    if is_coordinator():
+        raw = json.dumps(payload).encode()
+        if len(raw) + 4 > max_bytes:
+            raise ValueError(
+                f"strategy payload {len(raw)}B exceeds broadcast buffer "
+                f"{max_bytes}B — pass a larger max_bytes")
+        buf[:4] = np.frombuffer(
+            np.uint32(len(raw)).tobytes(), dtype=np.uint8)
+        buf[4:4 + len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf)
+    n = int(np.frombuffer(bytes(out[:4]), dtype=np.uint32)[0])
+    return json.loads(bytes(out[4:4 + n]).decode())
+
+
+def run_search_on_host0(search_fn: Callable[[], "object"]) -> dict:
+    """Run `search_fn` (returning a Strategy) on process 0 only; everyone
+    receives the serialized plan. Avoids divergent plans when on-device
+    calibration measurements differ across hosts — the reference pins the
+    search task to GPU0 for the same reason (mapper.cc select_task_options).
+    Returns the Strategy's overrides dict."""
+    from .parallel.strategies import Strategy
+
+    payload = None
+    if jax.process_count() <= 1 or is_coordinator():
+        payload = search_fn().to_json()
+    data = broadcast_json(payload)
+    return Strategy.from_json(data).overrides

@@ -78,6 +78,7 @@ class MachineResource:
 
 # Canonical mesh axis names. One global mesh; per-op placements are
 # PartitionSpecs over these axes. Degree-1 axes are harmless.
+AXIS_DCN = "dcn"        # cross-host (multislice) data parallel over DCN
 AXIS_DATA = "data"      # batch / sample parallel
 AXIS_MODEL = "model"    # tensor/attribute/parameter parallel
 AXIS_PIPE = "pipe"      # pipeline stages
@@ -85,6 +86,22 @@ AXIS_SEQ = "seq"        # sequence/context parallel (ring attention)
 AXIS_EXPERT = "expert"  # expert parallel (alias of model by default)
 
 DEFAULT_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ)
+# multi-host meshes lead with a DCN axis: collectives on it cross the
+# data-center network, everything inboard stays on ICI (the reference runs
+# one Legion process per node over GASNet/MPI; here the outer mesh axis IS
+# the host boundary, mapper.cc:291-306 / MULTI-NODE.md analog)
+MULTIHOST_AXES = (AXIS_DCN,) + DEFAULT_AXES
+
+
+def batch_axes_for(axis_sizes: dict) -> tuple[str, ...]:
+    """Mesh axes the batch dim rides under the data-parallel default: the
+    DCN axis (outer, when present) composed with `data`."""
+    axes = []
+    if axis_sizes.get(AXIS_DCN, 1) > 1:
+        axes.append(AXIS_DCN)
+    if axis_sizes.get(AXIS_DATA, 1) > 1 or not axes:
+        axes.append(AXIS_DATA)
+    return tuple(axes)
 
 
 @dataclass(frozen=True)

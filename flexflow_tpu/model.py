@@ -653,21 +653,47 @@ class FFModel:
                 machine_model_from_file(
                     self.config.machine_model_file, self.mesh)
                 if self.config.machine_model_file
-                else machine_model_for_mesh(self.mesh)
+                else machine_model_for_mesh(
+                    self.mesh, num_hosts=self.config.num_nodes)
             )
             cost_model = CostModel(machine)
-            if self.config.search_calibrate > 0:
+
+            def _calibrate():
                 # measure the dominant ops on the local chip so the search
                 # costs candidates from measurements, not the mfu guess
                 # (Simulator::measure_operator_cost, model.cu:38-75)
-                cost_model.calibrate_graph(
-                    g, top_k=self.config.search_calibrate)
+                if self.config.search_calibrate > 0:
+                    cost_model.calibrate_graph(
+                        g, top_k=self.config.search_calibrate)
+
             tensor_to_out[self.layers[-1].outputs[0].tensor_guid][0]._is_logits = True
-            g, choice, us = joint_graph_optimize(
-                g, self.mesh, self.config, cost_model)
-            self.graph = g
-            self._strategy = us.to_strategy(choice).overrides
-            used_substitutions = True
+            if jax.process_count() > 1:
+                # multi-host: search on process 0 only, broadcast the plan,
+                # and apply it to the ORIGINAL graph on every process (the
+                # reference's search-on-GPU0 + serialize pattern,
+                # mapper.cc:291-306 / model.cc:2830-2872) — rewritten-graph
+                # materialization is skipped because the broadcast Strategy
+                # expresses the same placements in logical-rank form
+                from .distributed import run_search_on_host0
+
+                def _search():
+                    # calibration only where its measurements are consumed
+                    # (process 0) — the other hosts' device time is not
+                    # wasted on benchmarks whose results get discarded
+                    _calibrate()
+                    _, choice, us = joint_graph_optimize(
+                        g, self.mesh, self.config, cost_model)
+                    return us.to_strategy(choice)
+
+                self._strategy = run_search_on_host0(_search)
+                self._assign_strategy()
+            else:
+                _calibrate()
+                g, choice, us = joint_graph_optimize(
+                    g, self.mesh, self.config, cost_model)
+                self.graph = g
+                self._strategy = us.to_strategy(choice).overrides
+                used_substitutions = True
         else:
             self._assign_strategy()
         if self.config.export_strategy_file:
@@ -723,9 +749,13 @@ class FFModel:
         over the `data` axis, weights replicated — the reference's
         data-parallel fallback (graph.cc:1939-1964). A searched or imported
         strategy overrides per-node specs via self._strategy."""
+        from .machine import batch_axes_for
         from .parallel.ops import derive_parallel_assignment
 
-        data_axis_sz = self.mesh.shape[AXIS_DATA]
+        batch_axes = batch_axes_for(dict(self.mesh.shape))
+        batch_deg = 1
+        for ax in batch_axes:
+            batch_deg *= self.mesh.shape.get(ax, 1)
         for node in self.graph.topo_order():
             ov = (self._strategy or {}).get(node.name, {})
             if node.is_parallel_op and node.inputs:
@@ -744,12 +774,13 @@ class FFModel:
                     dims = pt.shape.dims
                     assignment = [()] * len(dims)
                     if (
-                        data_axis_sz > 1
+                        batch_deg > 1
                         and len(dims) > 0
-                        and dims[0].size % data_axis_sz == 0
+                        and dims[0].size % batch_deg == 0
                         and not _is_expert_buffer(node)
                     ):
-                        assignment[0] = (AXIS_DATA,)
+                        # multi-host meshes compose (dcn, data) on the batch
+                        assignment[0] = batch_axes
                     pt.assign_axes(tuple(assignment))
             for i, spec_axes in ov.get("outputs", {}).items():
                 node.outputs[i].assign_axes(spec_axes)

@@ -335,23 +335,14 @@ def joint_graph_optimize(
     if config.perform_memory_search:
         _, mem_f = us.evaluate(best_choice)
         if mem_f > cm.machine.chip.hbm_bytes:
-            # λ binary search between pure-runtime and memory-lean
-            # placements of the final graph (graph_optimize_task,
-            # graph.cc:2056-2131); λ is part of the segment-cache key, so
-            # each probe re-optimizes under its own blended objective
-            lo, hi = 0.0, 1.0
-            for _ in range(5):
-                mid = (lo + hi) / 2
-                us_m = UnitySearch(
+            # λ binary search over the final graph's placements
+            # (shared helper; graph_optimize_task, graph.cc:2056-2131)
+            from .unity import lambda_memory_search
+
+            best_choice, us = lambda_memory_search(
+                lambda: UnitySearch(
                     best_g, mesh, config, cm, segment_cache=cache,
-                    pinned=derive_pinned_configs(best_g, mesh))
-                us_m._lambda = mid
-                choice_m = us_m.run()
-                _, mem_m = us_m.evaluate(choice_m)
-                if mem_m > cm.machine.chip.hbm_bytes:
-                    lo = mid
-                else:
-                    best_choice, us = choice_m, us_m
-                    hi = mid
+                    pinned=derive_pinned_configs(best_g, mesh)),
+                cm.machine.chip.hbm_bytes)
     apply_choice_to_graph(best_g, mesh, best_choice)
     return best_g, best_choice, us

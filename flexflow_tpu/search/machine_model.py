@@ -153,11 +153,24 @@ def machine_model_from_file(path: str, mesh) -> TPUMachineModel:
                 f"have {sorted(CHIPS)}")
         chip = CHIPS[chip_cfg]
     else:
-        base = CHIPS.get(chip_cfg.get("name", ""), CHIPS["v5p"])
+        name = chip_cfg.get("name", "custom")
+        base = CHIPS.get(name)
+        core = ("peak_flops", "hbm_bandwidth", "hbm_bytes",
+                "ici_bandwidth", "ici_links")
+        if base is None and not all(f in chip_cfg for f in core):
+            # unknown base chip: every core field must be spelled out,
+            # otherwise a typoed name would silently price against v5p
+            missing = [f for f in core if f not in chip_cfg]
+            raise ValueError(
+                f"machine model file {path}: chip name {name!r} is not a "
+                f"known base ({sorted(CHIPS)}) and the spec is missing "
+                f"{missing}")
+        base = base or CHIPS["v5p"]
         fields = {f: chip_cfg.get(f, getattr(base, f))
                   for f in ("name", "peak_flops", "hbm_bandwidth",
                             "hbm_bytes", "ici_bandwidth", "ici_links",
                             "ici_latency", "dcn_bandwidth", "dcn_latency")}
+        fields["name"] = name
         chip = ChipSpec(**fields)
     axis_sizes = dict(mesh.shape) if hasattr(mesh, "shape") else dict(mesh)
     links = {a: 1 for a in axis_sizes}
@@ -170,18 +183,22 @@ def machine_model_from_file(path: str, mesh) -> TPUMachineModel:
 
 def machine_model_for_mesh(mesh, chip: ChipSpec | None = None,
                            num_hosts: int = 1) -> TPUMachineModel:
+    from ..machine import AXIS_DCN
+
     chip = chip or detect_chip()
     axis_sizes = dict(mesh.shape) if hasattr(mesh, "shape") else dict(mesh)
-    # heuristic: the largest axis gets folded over 2 torus dims when the
-    # chip has >4 links (v5p 3D torus)
+    # collectives on the dedicated DCN axis (multi-host meshes lead with
+    # it, machine.MULTIHOST_AXES) cross the data-center network
+    over_dcn = {a for a in axis_sizes if a == AXIS_DCN}
+    if num_hosts > 1 and not over_dcn and axis_sizes:
+        # legacy spelling: a multi-host run without an explicit dcn axis —
+        # the outermost axis spans hosts
+        over_dcn.add(next(iter(axis_sizes)))
+    # heuristic: the largest ICI axis gets folded over 2 torus dims when
+    # the chip has >4 links (v5p 3D torus)
     links = {a: 1 for a in axis_sizes}
-    if chip.ici_links >= 6 and axis_sizes:
-        big = max(axis_sizes, key=lambda a: axis_sizes[a])
+    ici_axes = [a for a in axis_sizes if a not in over_dcn]
+    if chip.ici_links >= 6 and ici_axes:
+        big = max(ici_axes, key=lambda a: axis_sizes[a])
         links[big] = 2
-    over_dcn = frozenset()
-    if num_hosts > 1:
-        # outermost axis spans hosts
-        first = next(iter(axis_sizes)) if axis_sizes else None
-        if first is not None:
-            over_dcn = frozenset({first})
-    return TPUMachineModel(chip, axis_sizes, links, over_dcn)
+    return TPUMachineModel(chip, axis_sizes, links, frozenset(over_dcn))

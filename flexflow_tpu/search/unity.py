@@ -34,7 +34,7 @@ from typing import Optional
 from jax.sharding import PartitionSpec
 
 from ..fftype import OperatorType as OT, PARALLEL_OP_TYPES as _PARALLEL_OPS
-from ..machine import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
+from ..machine import AXIS_DATA, AXIS_MODEL, AXIS_SEQ, batch_axes_for
 from ..parallel.strategies import Strategy
 from .cost_model import (
     CostModel,
@@ -64,10 +64,10 @@ class NodeConfig:
     in_assigns: Optional[tuple] = None
 
 
-def _dp_assign(ndim, batch_ok=True, last_axes=()):
+def _dp_assign(ndim, batch_ok=True, last_axes=(), batch_axes=(AXIS_DATA,)):
     a = [()] * ndim
     if ndim > 0 and batch_ok:
-        a[0] = (AXIS_DATA,)
+        a[0] = tuple(batch_axes)
     if last_axes and ndim > 1:
         a[-1] = tuple(last_axes)
     return tuple(a)
@@ -84,6 +84,12 @@ class UnitySearch:
         self.axis_sizes = dict(mesh.shape)
         self.model_deg = self.axis_sizes.get(AXIS_MODEL, 1)
         self.data_deg = self.axis_sizes.get(AXIS_DATA, 1)
+        # multi-host meshes compose (dcn, data) on the batch dim; DCN-axis
+        # collectives are priced at DCN bandwidth by the machine model
+        self.batch_axes = batch_axes_for(self.axis_sizes)
+        self.batch_deg = 1
+        for ax in self.batch_axes:
+            self.batch_deg *= self.axis_sizes.get(ax, 1)
         self.order = graph.topo_order()
         # {guid -> NodeConfig} fixed by a substitution rewrite (joint
         # search): the placement DP searches only the remaining free nodes
@@ -109,9 +115,10 @@ class UnitySearch:
             return [pin]
         ndim = len(node.outputs[0].shape.dims) if node.outputs else 0
         batch_ok = (ndim > 0 and node.outputs and
-                    node.outputs[0].shape.dims[0].size % max(1, self.data_deg) == 0
+                    node.outputs[0].shape.dims[0].size % max(1, self.batch_deg) == 0
                     and node.op_type != OT.OP_GROUP_BY)
-        dp = NodeConfig("dp", _dp_assign(ndim, batch_ok))
+        dp = NodeConfig("dp", _dp_assign(ndim, batch_ok,
+                                          batch_axes=self.batch_axes))
         out = [dp]
         if self.config.only_data_parallel or self.model_deg <= 1:
             return out
@@ -124,12 +131,14 @@ class UnitySearch:
             if p.out_channels % self.model_deg == 0:
                 out.append(NodeConfig(
                     "tp_col",
-                    _dp_assign(ndim, batch_ok, last_axes=(AXIS_MODEL,)),
+                    _dp_assign(ndim, batch_ok, last_axes=(AXIS_MODEL,),
+                               batch_axes=self.batch_axes),
                     (("kernel", PartitionSpec(None, AXIS_MODEL)),
                      ("bias", PartitionSpec(AXIS_MODEL))),
                 ))
             out.append(NodeConfig(
-                "tp_row", _dp_assign(ndim, batch_ok),
+                "tp_row", _dp_assign(ndim, batch_ok,
+                                      batch_axes=self.batch_axes),
                 (("kernel", PartitionSpec(AXIS_MODEL, None)),
                  ("bias", PartitionSpec())),
                 psum_axes=(AXIS_MODEL,),
@@ -144,7 +153,9 @@ class UnitySearch:
                 ws += [("wo", PartitionSpec(AXIS_MODEL, None)),
                        ("bo", PartitionSpec())]
                 out.append(NodeConfig(
-                    "tp_attn", _dp_assign(ndim, batch_ok), tuple(ws),
+                    "tp_attn",
+                    _dp_assign(ndim, batch_ok, batch_axes=self.batch_axes),
+                    tuple(ws),
                     psum_axes=(AXIS_MODEL,),
                 ))
         elif node.op_type == OT.OP_EXPERTS and allow_attr:
@@ -153,14 +164,17 @@ class UnitySearch:
                 ws = [("kernel", PartitionSpec(AXIS_MODEL, None, None))]
                 if p.use_bias:
                     ws.append(("bias", PartitionSpec(AXIS_MODEL, None)))
-                out.append(NodeConfig("ep", _dp_assign(ndim, batch_ok),
+                out.append(NodeConfig("ep",
+                                      _dp_assign(ndim, batch_ok,
+                                                 batch_axes=self.batch_axes),
                                       tuple(ws)))
         elif node.op_type == OT.OP_EMBEDDING and allow_param:
             p = node.params
             if p.out_channels % self.model_deg == 0:
                 out.append(NodeConfig(
                     "tp_col",
-                    _dp_assign(ndim, batch_ok, last_axes=(AXIS_MODEL,)),
+                    _dp_assign(ndim, batch_ok, last_axes=(AXIS_MODEL,),
+                               batch_axes=self.batch_axes),
                     (("kernel", PartitionSpec(None, AXIS_MODEL)),),
                 ))
         elif node.op_type in _FEATURE_ELEMENTWISE and ndim > 1:
@@ -170,6 +184,7 @@ class UnitySearch:
             if dims[-1].size % self.model_deg == 0:
                 out.append(NodeConfig(
                     "feat", _dp_assign(ndim, batch_ok,
+                                       batch_axes=self.batch_axes,
                                        last_axes=(AXIS_MODEL,)),
                 ))
         return out
@@ -225,7 +240,8 @@ class UnitySearch:
                 src_cfg = choice.get(src.guid)
                 src_assign = (src_cfg.out_assign if src_cfg
                               else _dp_assign(
-                                  len(src.outputs[e.src_idx].shape.dims)))
+                                  len(src.outputs[e.src_idx].shape.dims),
+                                  batch_axes=self.batch_axes))
                 shape = tuple(d.size for d in
                               src.outputs[e.src_idx].shape.dims
                               if not d.is_replica_dim)
@@ -267,9 +283,10 @@ class UnitySearch:
                 return cfg.in_assigns[dst_idx]
             return None
         if cfg.name == "tp_row" and dst_idx == 0:
-            return _dp_assign(ndim, True, last_axes=(AXIS_MODEL,))
+            return _dp_assign(ndim, True, last_axes=(AXIS_MODEL,),
+                              batch_axes=self.batch_axes)
         if cfg.name in ("dp", "tp_col", "tp_attn", "ep") and dst_idx == 0:
-            return _dp_assign(ndim, True)
+            return _dp_assign(ndim, True, batch_axes=self.batch_axes)
         return None
 
     # ---------------------------------------------------- bottleneck DP
@@ -534,6 +551,32 @@ _FEATURE_ELEMENTWISE = frozenset({
 })
 
 
+def lambda_memory_search(make_search, hbm_bytes: float, iters: int = 5):
+    """λ binary search between pure-runtime and memory-lean strategies
+    (graph_optimize_task, graph.cc:2056-2131). `make_search()` supplies the
+    UnitySearch for each probe (callers reuse one instance or rebuild a
+    pinned one); λ is part of the segment-cache key, so every probe
+    re-optimizes under its own blended objective. Returns (choice, search)
+    of the lightest feasible probe — or of the last probe when none fits,
+    matching the reference's fall-through when even λ=1 exceeds memory."""
+    lo, hi = 0.0, 1.0
+    best = None
+    last = None
+    for _ in range(iters):
+        mid = (lo + hi) / 2
+        s = make_search()
+        s._lambda = mid
+        choice = s.run()
+        _, mem = s.evaluate(choice)
+        last = (choice, s)
+        if mem > hbm_bytes:
+            lo = mid
+        else:
+            best = (choice, s)
+            hi = mid
+    return best or last
+
+
 def search_strategy(graph, mesh, config,
                     machine: Optional[TPUMachineModel] = None,
                     cost_model: Optional[CostModel] = None) -> Strategy:
@@ -545,21 +588,8 @@ def search_strategy(graph, mesh, config,
     cm = cost_model or CostModel(machine)
     search = UnitySearch(graph, mesh, config, cm)
     if config.perform_memory_search:
-        # λ binary search between pure-runtime and memory-lean strategies
-        # (graph_optimize_task, graph.cc:2056-2131)
-        lo, hi = 0.0, 1.0
-        best_choice = None
-        for _ in range(5):
-            mid = (lo + hi) / 2
-            search._lambda = mid
-            choice = search.run()
-            _, mem = search.evaluate(choice)
-            if mem > machine.chip.hbm_bytes:
-                lo = mid
-            else:
-                best_choice = choice
-                hi = mid
-        choice = best_choice or choice
+        choice, search = lambda_memory_search(
+            lambda: search, machine.chip.hbm_bytes)
     else:
         choice = search.run()
     return search.to_strategy(choice)

@@ -1,0 +1,107 @@
+"""Multi-host/DCN tests on the virtual 8-device CPU mesh: (dcn, data, model)
+mesh construction, DCN-priced collectives in the machine model, the
+search-on-host-0 plan broadcast helpers, and end-to-end training on a
+multi-host-shaped mesh (reference: mapper.cc:291-306, MULTI-NODE.md; recipe
+in MULTIHOST.md)."""
+
+import sys
+
+import numpy as np
+import pytest
+
+
+def test_mesh_shape_with_nodes_flag():
+    sys.argv = ["t", "--nodes", "2", "--mesh", "2,2,1,1"]
+    from flexflow_tpu import FFConfig
+    from flexflow_tpu.machine import MULTIHOST_AXES
+
+    c = FFConfig()
+    ms = c.mesh_shape()
+    assert ms.axis_names == MULTIHOST_AXES
+    assert ms.axis_sizes == (2, 2, 2, 1, 1)
+
+
+def test_mesh_shape_explicit_five_axes():
+    sys.argv = ["t", "--mesh", "2,2,2,1,1"]
+    from flexflow_tpu import FFConfig
+    from flexflow_tpu.machine import MULTIHOST_AXES
+
+    c = FFConfig()
+    ms = c.mesh_shape()
+    assert ms.axis_names == MULTIHOST_AXES
+    assert ms.axis_sizes == (2, 2, 2, 1, 1)
+
+
+def test_machine_model_prices_dcn_axis():
+    from flexflow_tpu.search.machine_model import CHIPS, machine_model_for_mesh
+
+    m = machine_model_for_mesh({"dcn": 2, "data": 2, "model": 2},
+                               chip=CHIPS["v5p"])
+    assert "dcn" in m.axis_over_dcn
+    # same payload, same axis size: DCN must be far slower than ICI
+    assert m.all_reduce(1e8, "dcn") > 5 * m.all_reduce(1e8, "data")
+    # the torus-fold heuristic must not give the DCN axis extra ICI links
+    assert m.axis_links["dcn"] == 1
+
+
+def test_broadcast_json_single_process_passthrough():
+    from flexflow_tpu.distributed import broadcast_json, run_search_on_host0
+    from flexflow_tpu.parallel.strategies import Strategy
+
+    payload = {"version": 1, "nodes": {"fc1": {
+        "outputs": {"0": [["dcn", "data"], []]}, "weights": {}}}}
+    assert broadcast_json(payload) == payload
+
+    s = Strategy()
+    s.set_output("fc1", 0, (("dcn", "data"), ()))
+    got = run_search_on_host0(lambda: s)
+    assert got["fc1"]["outputs"][0] == (("dcn", "data"), ())
+
+
+def test_train_on_dcn_mesh():
+    """End-to-end: (dcn=2, data=2, model=2) mesh, batch sharded over
+    (dcn, data), searched TP over `model`, converges."""
+    sys.argv = ["t", "--mesh", "2,2,2,1,1", "--budget", "4",
+                "--enable-parameter-parallel"]
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+
+    config = FFConfig()
+    config.batch_size = 32
+    ff = FFModel(config)
+    x = ff.create_tensor((32, 32))
+    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 64, ActiMode.AC_MODE_RELU, name="fc2")
+    t = ff.softmax(ff.dense(t, 10, name="out"))
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    assert dict(ff.mesh.shape)["dcn"] == 2
+
+    # data-parallel default composes (dcn, data) on the batch dim
+    from flexflow_tpu.fftype import OperatorType as OT
+
+    input_node = next(n for n in ff.graph.topo_order()
+                      if n.op_type == OT.OP_INPUT)
+    assert input_node.outputs[0].axis_assignment[0] == ("dcn", "data")
+
+    rs = np.random.RandomState(0)
+    c = rs.randn(10, 32) * 3
+    y = rs.randint(0, 10, 1024)
+    xs = (c[y] + rs.randn(1024, 32)).astype(np.float32)
+    ff.fit(xs, y.reshape(-1, 1).astype(np.int32), epochs=2)
+    assert ff.get_perf_metrics().get_accuracy() >= 0.85
+
+
+def test_search_avoids_tp_across_dcn():
+    """The cost model must keep `model`-axis traffic on ICI: a tp_col/tp_row
+    pair prices its activation psum on `model` (ICI), and the same plan with
+    the model axis over DCN would be far more expensive — sanity-check the
+    pricing asymmetry that steers the search."""
+    from flexflow_tpu.search.machine_model import CHIPS, TPUMachineModel
+
+    ici = TPUMachineModel(CHIPS["v5p"], {"dcn": 2, "model": 4},
+                          axis_over_dcn=frozenset({"dcn"}))
+    bytes_ = 64 * 1024 * 1024
+    assert ici.all_reduce(bytes_, "model") < ici.all_reduce(bytes_, "dcn")
