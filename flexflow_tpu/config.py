@@ -99,6 +99,14 @@ class FFConfig:
     def __post_init__(self):
         argv = sys.argv[1:]
         self.parse_args(argv)
+        try:
+            if self.num_nodes == 1 and jax.process_count() > 1:
+                # zero-config multi-controller runs (MULTIHOST.md): one
+                # process per host, so the fleet's node count is the
+                # process count unless --nodes overrode it
+                self.num_nodes = jax.process_count()
+        except Exception:
+            pass
         if self.workers_per_node == 0:
             try:
                 if jax.process_count() > 1:

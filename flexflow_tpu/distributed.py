@@ -54,12 +54,21 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+_ERR_KEY = "__broadcast_error__"
+
+
 def broadcast_json(payload: Optional[dict], max_bytes: int = 1 << 20) -> dict:
     """Broadcast a JSON-serializable dict from process 0 to all processes
     (the strategy-serialization hop of GRAPH_OPTIMIZE_TASK). Single-process
     runs return the payload unchanged. The payload is framed as
     [length u32][utf-8 bytes][zero padding] in a fixed-size u8 buffer so
-    every process contributes an identically-shaped array."""
+    every process contributes an identically-shaped array.
+
+    Coordinator-side failures (oversized payload, serialization error) are
+    broadcast as a small error marker instead of raised before the
+    collective — otherwise the other processes would block in
+    broadcast_one_to_all forever; every process then raises the same
+    RuntimeError in lockstep."""
     if jax.process_count() <= 1:
         assert payload is not None
         return payload
@@ -67,17 +76,24 @@ def broadcast_json(payload: Optional[dict], max_bytes: int = 1 << 20) -> dict:
 
     buf = np.zeros(max_bytes, dtype=np.uint8)
     if is_coordinator():
-        raw = json.dumps(payload).encode()
-        if len(raw) + 4 > max_bytes:
-            raise ValueError(
-                f"strategy payload {len(raw)}B exceeds broadcast buffer "
-                f"{max_bytes}B — pass a larger max_bytes")
+        try:
+            raw = json.dumps(payload).encode()
+            if len(raw) + 4 > max_bytes:
+                raise ValueError(
+                    f"payload {len(raw)}B exceeds broadcast buffer "
+                    f"{max_bytes}B — pass a larger max_bytes")
+        except Exception as e:  # keep the fleet in lockstep
+            raw = json.dumps({_ERR_KEY: f"{type(e).__name__}: {e}"}).encode()
+            raw = raw[:max_bytes - 4]
         buf[:4] = np.frombuffer(
             np.uint32(len(raw)).tobytes(), dtype=np.uint8)
         buf[4:4 + len(raw)] = np.frombuffer(raw, dtype=np.uint8)
     out = multihost_utils.broadcast_one_to_all(buf)
     n = int(np.frombuffer(bytes(out[:4]), dtype=np.uint32)[0])
-    return json.loads(bytes(out[4:4 + n]).decode())
+    data = json.loads(bytes(out[4:4 + n]).decode())
+    if isinstance(data, dict) and _ERR_KEY in data:
+        raise RuntimeError(f"broadcast failed on process 0: {data[_ERR_KEY]}")
+    return data
 
 
 def run_search_on_host0(search_fn: Callable[[], "object"]) -> dict:
@@ -85,11 +101,20 @@ def run_search_on_host0(search_fn: Callable[[], "object"]) -> dict:
     receives the serialized plan. Avoids divergent plans when on-device
     calibration measurements differ across hosts — the reference pins the
     search task to GPU0 for the same reason (mapper.cc select_task_options).
+    A search failure on process 0 is broadcast as an error marker so every
+    process raises together instead of the fleet hanging in the collective.
     Returns the Strategy's overrides dict."""
     from .parallel.strategies import Strategy
 
     payload = None
     if jax.process_count() <= 1 or is_coordinator():
-        payload = search_fn().to_json()
+        try:
+            payload = search_fn().to_json()
+        except Exception as e:
+            if jax.process_count() <= 1:
+                raise
+            payload = {_ERR_KEY: f"search failed: {type(e).__name__}: {e}"}
     data = broadcast_json(payload)
+    if isinstance(data, dict) and _ERR_KEY in data:
+        raise RuntimeError(data[_ERR_KEY])
     return Strategy.from_json(data).overrides

@@ -172,13 +172,17 @@ def machine_model_from_file(path: str, mesh) -> TPUMachineModel:
                             "ici_latency", "dcn_bandwidth", "dcn_latency")}
         fields["name"] = name
         chip = ChipSpec(**fields)
+    from ..machine import AXIS_DCN
+
     axis_sizes = dict(mesh.shape) if hasattr(mesh, "shape") else dict(mesh)
     links = {a: 1 for a in axis_sizes}
     links.update({a: int(v) for a, v in data.get("axis_links", {}).items()
                   if a in links})
-    over_dcn = frozenset(a for a in data.get("dcn_axes", ())
-                         if a in axis_sizes)
-    return TPUMachineModel(chip, axis_sizes, links, over_dcn)
+    # the canonical dcn axis always rides DCN, with or without a file entry
+    # (same auto-marking as machine_model_for_mesh)
+    over_dcn = {a for a in data.get("dcn_axes", ()) if a in axis_sizes}
+    over_dcn |= {a for a in axis_sizes if a == AXIS_DCN}
+    return TPUMachineModel(chip, axis_sizes, links, frozenset(over_dcn))
 
 
 def machine_model_for_mesh(mesh, chip: ChipSpec | None = None,

@@ -350,6 +350,21 @@ def propagate_parallel_state(graph: Graph):
             out_shapes = [_attention_parallel(node, in_shapes,
                                               weight_partition)]
             out_partial = any(d.is_replica_dim for d in out_shapes[0].dims)
+        elif node.op_type == OT.OP_CONV2D:
+            if any(in_partial):
+                raise ValueError(
+                    f"{node.name}: Conv2D consuming a partial-sum tensor "
+                    f"is unsupported (bias/activation per replica)")
+            out_shapes = [_conv_parallel(node, in_shapes[0],
+                                         weight_partition)]
+            out_partial = any(d.is_replica_dim for d in out_shapes[0].dims)
+        elif node.op_type == OT.OP_EMBEDDING:
+            if any(in_partial):
+                raise ValueError(
+                    f"{node.name}: embedding lookup over partial-sum "
+                    f"indices is meaningless")
+            out_shapes = [_embedding_parallel(node, in_shapes[0],
+                                              weight_partition)]
         elif node.op_type in _PASSTHROUGH:
             if in_partial and in_partial[0] and \
                     node.op_type not in _LINEAR_SAFE:
@@ -449,6 +464,90 @@ def _linear_parallel(node, in_shape: ParallelTensorShape, wp: dict):
         out_dims.append(ParallelDim(feat_deg, feat_deg,
                                     is_replica_dim=True))
     return ParallelTensorShape(tuple(out_dims), in_shape.dtype)
+
+
+def _conv_parallel(node, in_shape: ParallelTensorShape, wp: dict):
+    """Conv2D (NCHW / OIHW) under parallel input state (reference
+    conv_2d.cc dim mappings):
+    - sample-dim degrees propagate;
+    - input replica dim (degree r) → kernel out-channel dim (O) sharded r,
+      output channel dim sharded r, replica consumed  [channel TP];
+    - input channel dim sharded (degree c, groups == 1) → kernel in-channel
+      dim (I) sharded c, output gains a replica dim of degree c (partial
+      sums)  [row-style]."""
+    dims = in_shape.dims
+    logical = [d for d in dims if not d.is_replica_dim]
+    replicas = [d for d in dims if d.is_replica_dim]
+    if len(replicas) > 1:
+        raise ValueError(f"{node.name}: multiple replica dims unsupported")
+    if any(d.degree > 1 for d in logical[2:]):
+        raise ValueError(
+            f"{node.name}: spatially-sharded conv input unsupported")
+    r = replicas[0].degree if replicas else 1
+    chan_deg = logical[1].degree
+    if r > 1 and chan_deg > 1:
+        raise ValueError(
+            f"{node.name}: simultaneous replicate + channel partition "
+            f"unsupported")
+    p = node.params
+    out_logical = node.op_def.infer_shapes(
+        p, [tuple(d.size for d in logical)])[0]
+    out_dims = [replace(logical[0])]
+    if r > 1:
+        if p.out_channels % r != 0:
+            raise ValueError(
+                f"{node.name}: out_channels {p.out_channels} % {r} != 0")
+        out_dims.append(ParallelDim(p.out_channels, r))
+        wp["kernel"] = (0, r)
+        if p.use_bias:
+            wp["bias"] = (0, r)
+    else:
+        out_dims.append(ParallelDim(p.out_channels))
+    out_dims += [ParallelDim(s) for s in out_logical[2:]]
+    if chan_deg > 1:
+        if p.groups != 1:
+            raise ValueError(
+                f"{node.name}: channel-sharded grouped conv unsupported")
+        wp["kernel"] = (1, chan_deg)
+        out_dims.append(ParallelDim(chan_deg, chan_deg,
+                                    is_replica_dim=True))
+    return ParallelTensorShape(tuple(out_dims), in_shape.dtype)
+
+
+def _embedding_parallel(node, in_shape: ParallelTensorShape, wp: dict):
+    """Embedding under parallel input state (reference embedding.cc:
+    partitionable on the sample dim or — via a replicated input — on the
+    output-channel dim):
+    - sample-dim degrees propagate through the lookup;
+    - input replica dim (degree r) → table sharded on the embedding dim,
+      output feature dim sharded r, replica consumed (each chip gathers its
+      column slice — full value, no partial sums)."""
+    from ..fftype import AggrMode
+
+    dims = in_shape.dims
+    logical = [d for d in dims if not d.is_replica_dim]
+    replicas = [d for d in dims if d.is_replica_dim]
+    if len(replicas) > 1:
+        raise ValueError(f"{node.name}: multiple replica dims unsupported")
+    if any(d.degree > 1 for d in logical[1:]):
+        raise ValueError(
+            f"{node.name}: entry-dim-sharded embedding input unsupported")
+    r = replicas[0].degree if replicas else 1
+    p = node.params
+    if p.aggr == AggrMode.AGGR_MODE_NONE:
+        out_dims = [replace(d) for d in logical]
+    else:
+        out_dims = [replace(d) for d in logical[:-1]]
+    if r > 1:
+        if p.out_channels % r != 0:
+            raise ValueError(
+                f"{node.name}: out_channels {p.out_channels} % {r} != 0")
+        out_dims.append(ParallelDim(p.out_channels, r))
+        wp["kernel"] = (1, r)
+    else:
+        out_dims.append(ParallelDim(p.out_channels))
+    # lookups emit the table dtype, not the integer index dtype
+    return ParallelTensorShape(tuple(out_dims), p.data_type)
 
 
 def _attention_parallel(node, in_shapes, wp: dict):
@@ -701,6 +800,85 @@ def create_partition_softmax_combine(degree: int) -> GraphXfer:
     return _passthrough_partition(OT.OP_SOFTMAX, degree, "softmax")
 
 
+def create_partition_conv2d_combine(degree: int) -> GraphXfer:
+    """Repartition(sample) → Conv2D → Combine(sample)
+    (substitution.cc create_partition_conv2d_combine)."""
+    x = GraphXfer(f"partition_conv2d_combine[deg={degree}]")
+    inp = x.new_input(0)
+    c1 = OpX(OT.OP_CONV2D, (inp,))
+    rep = OpX(OT.OP_REPARTITION, (inp,),
+              make_params=lambda m: RepartitionParams(0, degree))
+    c2 = OpX(OT.OP_CONV2D, (rep.outputs[0],), match_src=c1)
+    comb = OpX(OT.OP_COMBINE, (c2.outputs[0],),
+               make_params=lambda m: CombineParams(0, degree))
+    x.src_ops = [c1]
+    x.dst_ops = [rep, c2, comb]
+    x.map_output(c1.outputs[0], comb.outputs[0])
+    return x
+
+
+def create_replicate_conv2d_combine(degree: int) -> GraphXfer:
+    """Replicate → Conv2D(out-channel-sharded kernel) → Combine(channel):
+    the channel/attribute-parallel conv rewrite (substitution.cc
+    create_partition_attention_combine's conv sibling)."""
+    x = GraphXfer(f"replicate_conv2d_combine[deg={degree}]")
+    inp = x.new_input(0)
+    c1 = OpX(OT.OP_CONV2D, (inp,),
+             constraints=(lambda n: n.params.out_channels % degree == 0,))
+    repl = OpX(OT.OP_REPLICATE, (inp,),
+               make_params=lambda m: ReplicateParams(degree))
+    c2 = OpX(OT.OP_CONV2D, (repl.outputs[0],), match_src=c1)
+    comb = OpX(OT.OP_COMBINE, (c2.outputs[0],),
+               make_params=lambda m: CombineParams(1, degree))
+    x.src_ops = [c1]
+    x.dst_ops = [repl, c2, comb]
+    x.map_output(c1.outputs[0], comb.outputs[0])
+    return x
+
+
+def create_partition_pool2d_combine(degree: int) -> GraphXfer:
+    return _passthrough_partition(OT.OP_POOL2D, degree, "pool2d")
+
+
+def create_partition_concat_combine(degree: int) -> GraphXfer:
+    """Repartition both concat operands on sample, concat, Combine back —
+    the 2-ary instance (substitution.cc create_partition_concat_combine;
+    the reference generates per num_inputs too)."""
+    x = GraphXfer(f"partition_concat_combine[deg={degree}]")
+    a, b = x.new_input(0), x.new_input(1)
+    cat1 = OpX(OT.OP_CONCAT, (a, b),
+               constraints=(lambda n: n.params.axis != 0,))
+    rep1 = OpX(OT.OP_REPARTITION, (a,),
+               make_params=lambda m: RepartitionParams(0, degree))
+    rep2 = OpX(OT.OP_REPARTITION, (b,),
+               make_params=lambda m: RepartitionParams(0, degree))
+    cat2 = OpX(OT.OP_CONCAT, (rep1.outputs[0], rep2.outputs[0]),
+               match_src=cat1)
+    comb = OpX(OT.OP_COMBINE, (cat2.outputs[0],),
+               make_params=lambda m: CombineParams(0, degree))
+    x.src_ops = [cat1]
+    x.dst_ops = [rep1, rep2, cat2, comb]
+    x.map_output(cat1.outputs[0], comb.outputs[0])
+    return x
+
+
+def create_partition_embedding_combine(degree: int) -> GraphXfer:
+    """Repartition(sample) → Embedding → Combine(sample)
+    (embedding.cc is partitionable on the sample dim)."""
+    x = GraphXfer(f"partition_embedding_combine[deg={degree}]")
+    inp = x.new_input(0)
+    e1 = OpX(OT.OP_EMBEDDING, (inp,))
+    rep = OpX(OT.OP_REPARTITION, (inp,),
+              make_params=lambda m: RepartitionParams(0, degree))
+    e2 = OpX(OT.OP_EMBEDDING, (rep.outputs[0],), match_src=e1)
+    comb = OpX(OT.OP_COMBINE, (e2.outputs[0],),
+               make_params=lambda m: CombineParams(0, degree))
+    x.src_ops = [e1]
+    x.dst_ops = [rep, e2, comb]
+    x.map_output(e1.outputs[0], comb.outputs[0])
+    return x
+
+
 def create_linear_relu_merge() -> GraphXfer:
     """Fuse Linear(no act) + ReLU into Linear(relu) — the algebraic (non-
     parallel) substitution family (substitution.cc create_linear_relu_merge).
@@ -739,6 +917,16 @@ _GENERATORS = {
         lambda deg, **kw: create_partition_relu_combine(deg),
     "partition_softmax_combine":
         lambda deg, **kw: create_partition_softmax_combine(deg),
+    "partition_conv2d_combine":
+        lambda deg, **kw: create_partition_conv2d_combine(deg),
+    "replicate_conv2d_combine":
+        lambda deg, **kw: create_replicate_conv2d_combine(deg),
+    "partition_pool2d_combine":
+        lambda deg, **kw: create_partition_pool2d_combine(deg),
+    "partition_concat_combine":
+        lambda deg, **kw: create_partition_concat_combine(deg),
+    "partition_embedding_combine":
+        lambda deg, **kw: create_partition_embedding_combine(deg),
     "linear_relu_merge": lambda deg, **kw: create_linear_relu_merge(),
 }
 
@@ -757,6 +945,7 @@ def generate_all_pcg_xfers(mesh, config) -> list[GraphXfer]:
         for act in acts:
             xfers.append(create_replicate_linear_combine(model_deg, act))
         xfers.append(create_replicate_attention_reduce(model_deg))
+        xfers.append(create_replicate_conv2d_combine(model_deg))
     if data_deg > 1:
         for act in acts:
             xfers.append(create_partition_linear_combine(data_deg, act))
@@ -764,25 +953,179 @@ def generate_all_pcg_xfers(mesh, config) -> list[GraphXfer]:
         xfers.append(create_partition_add_combine(data_deg))
         xfers.append(create_partition_relu_combine(data_deg))
         xfers.append(create_partition_softmax_combine(data_deg))
+        xfers.append(create_partition_conv2d_combine(data_deg))
+        xfers.append(create_partition_pool2d_combine(data_deg))
+        xfers.append(create_partition_concat_combine(data_deg))
+        xfers.append(create_partition_embedding_combine(data_deg))
     return xfers
+
+
+_ACT_NAMES = {
+    "none": ActiMode.AC_MODE_NONE, "relu": ActiMode.AC_MODE_RELU,
+    "sigmoid": ActiMode.AC_MODE_SIGMOID,
+    "gelu": ActiMode.AC_MODE_GELU, "tanh": ActiMode.AC_MODE_TANH,
+}
+
+# parallel-op param constructors for pattern rules: field lists give the
+# JSON "params" keys in positional order
+_PARALLEL_PARAMS = {
+    OT.OP_REPARTITION: (RepartitionParams, ("dim", "degree")),
+    OT.OP_COMBINE: (CombineParams, ("dim", "degree")),
+    OT.OP_REPLICATE: (ReplicateParams, ("degree",)),
+    OT.OP_REDUCTION: (ReductionParams, ("degree",)),
+}
+
+
+def _op_type_by_name(name: str) -> OT:
+    key = f"OP_{name.upper()}"
+    try:
+        return OT[key]
+    except KeyError:
+        raise ValueError(f"unknown op type {name!r} in substitution rule")
+
+
+def _resolve_attr_value(v):
+    """JSON attr values: activation names resolve to ActiMode; everything
+    else passes through."""
+    if isinstance(v, str) and v.strip().lower() in _ACT_NAMES:
+        return _ACT_NAMES[v.strip().lower()]
+    return v
+
+
+def _make_constraint(spec: dict):
+    """One source-op constraint: {"attr": f, "eq": v} (equality, enum names
+    resolved) or {"attr": f, "mod": d} (divisibility) — the expressible
+    subset of substitution_loader.cc's PMParameter conditions."""
+    attr = spec["attr"]
+    if "eq" in spec:
+        want = _resolve_attr_value(spec["eq"])
+        return lambda n: getattr(n.params, attr, None) == want
+    if "mod" in spec:
+        d = int(spec["mod"])
+        return lambda n: getattr(n.params, attr, 0) % d == 0
+    raise ValueError(f"constraint {spec} needs 'eq' or 'mod'")
+
+
+def compile_pattern_rule(rule: dict) -> GraphXfer:
+    """Compile one declarative src→dst pattern rule into a GraphXfer — the
+    substitution_loader.cc analog, able to express NEW rewrites (arbitrary
+    ops, multi-op patterns, constraints), not just parameterize built-ins.
+
+    Schema:
+      {"name": str,
+       "src": [{"op": "linear", "inputs": ["$0"], "out": "l1",
+                "constraints": [{"attr": "activation", "eq": "none"}]}],
+       "dst": [{"op": "repartition", "inputs": ["$0"],
+                "params": {"dim": 0, "degree": 4}, "out": "r1"},
+               {"op": "linear", "inputs": ["r1"], "match": "l1",
+                "params_update": {"activation": "relu"}, "out": "l2"},
+               ...],
+       "map_outputs": [["l1", "c1"]]}
+
+    `inputs` entries: "$i" = the xfer's free input slot i; "name" or
+    "name:idx" = output idx of a previously declared pattern op. `match`
+    makes a dst compute op inherit the named src op's params/weights
+    (matchOpX); `params_update` overrides fields on the inherited params;
+    parallel-op `params` build the op's param struct."""
+    x = GraphXfer(rule.get("name", "pattern_rule"))
+    tensors: dict[str, TensorX] = {}
+
+    def resolve_input(ref: str) -> TensorX:
+        if ref.startswith("$"):
+            return x.new_input(int(ref[1:]))
+        name, _, idx = ref.partition(":")
+        if name not in tensors:
+            raise ValueError(
+                f"rule {x.name}: input {ref!r} references unknown op")
+        base = tensors[name]
+        if idx:
+            return TensorX(base.op, int(idx))
+        return base
+
+    named_ops: dict[str, OpX] = {}
+    for spec in rule.get("src", []):
+        ot = _op_type_by_name(spec["op"])
+        ins = tuple(resolve_input(r) for r in spec.get("inputs", []))
+        cons = tuple(_make_constraint(c)
+                     for c in spec.get("constraints", []))
+        op = OpX(ot, ins, num_outputs=int(spec.get("num_outputs", 1)),
+                 constraints=cons)
+        x.src_ops.append(op)
+        out = spec.get("out")
+        if out:
+            named_ops[out] = op
+            tensors[out] = op.outputs[0]
+
+    for spec in rule.get("dst", []):
+        ot = _op_type_by_name(spec["op"])
+        ins = tuple(resolve_input(r) for r in spec.get("inputs", []))
+        if ot in _PARALLEL_PARAMS:
+            cls, fields = _PARALLEL_PARAMS[ot]
+            params = spec.get("params", {})
+            args = [params[f] for f in fields]
+            op = OpX(ot, ins, make_params=lambda m, c=cls, a=tuple(args):
+                     c(*a))
+        elif "match" in spec:
+            src_op = named_ops.get(spec["match"])
+            if src_op is None or src_op not in x.src_ops:
+                raise ValueError(
+                    f"rule {x.name}: match={spec['match']!r} names no "
+                    f"source op")
+            updates = {k: _resolve_attr_value(v)
+                       for k, v in spec.get("params_update", {}).items()}
+            mk = ((lambda m, s=src_op, u=dict(updates):
+                   replace(m[s].params, **u)) if updates else None)
+            op = OpX(ot, ins, num_outputs=int(spec.get("num_outputs", 1)),
+                     match_src=src_op, make_params=mk)
+        else:
+            raise ValueError(
+                f"rule {x.name}: dst op {spec['op']!r} needs 'match' (to "
+                f"inherit a source op's params) or must be a parallel op "
+                f"with 'params'")
+        x.dst_ops.append(op)
+        out = spec.get("out")
+        if out:
+            named_ops[out] = op
+            tensors[out] = op.outputs[0]
+
+    for src_ref, dst_ref in rule.get("map_outputs", []):
+        sname, _, sidx = src_ref.partition(":")
+        dname, _, didx = dst_ref.partition(":")
+        if sname not in named_ops or dname not in named_ops:
+            raise ValueError(
+                f"rule {x.name}: map_outputs references unknown op")
+        x.map_output(TensorX(named_ops[sname], int(sidx or 0)),
+                     TensorX(named_ops[dname], int(didx or 0)))
+    if not x.src_ops or not x.dst_ops or not x.mapped_outputs:
+        raise ValueError(
+            f"rule {x.name}: needs src ops, dst ops, and map_outputs")
+    return x
 
 
 def load_rule_collection(path: str, mesh) -> list[GraphXfer]:
     """JSON rule loader wired to --substitution-json (reference
-    substitution_loader.cc). Format:
-      {"rules": [{"generator": "replicate_linear_combine",
-                  "degree": 4, "activation": "relu"}, ...]}
-    `degree` defaults to the mesh's model-axis size. Unknown generators
-    raise (matching the reference loader's strictness)."""
+    substitution_loader.cc + substitutions/graph_subst_3_v2.json). Two rule
+    forms, mixable in one file:
+
+      {"rules": [
+         {"generator": "replicate_linear_combine",
+          "degree": 4, "activation": "relu"},        # parameterized built-in
+         {"name": "...", "src": [...], "dst": [...],
+          "map_outputs": [...]}                       # full src→dst pattern
+      ]}
+
+    `degree` defaults to the mesh's model-axis size. Unknown generators /
+    ops / malformed patterns raise (matching the reference loader's
+    strictness)."""
     with open(path) as f:
         data = json.load(f)
     sizes = dict(mesh.shape)
     default_deg = sizes.get(AXIS_MODEL, 1)
-    acts = {"none": ActiMode.AC_MODE_NONE, "relu": ActiMode.AC_MODE_RELU,
-            "sigmoid": ActiMode.AC_MODE_SIGMOID,
-            "gelu": ActiMode.AC_MODE_GELU, "tanh": ActiMode.AC_MODE_TANH}
     xfers = []
     for rule in data.get("rules", []):
+        if "src" in rule or "dst" in rule:
+            xfers.append(compile_pattern_rule(rule))
+            continue
         gen = rule.get("generator")
         if gen not in _GENERATORS:
             raise ValueError(
@@ -791,11 +1134,11 @@ def load_rule_collection(path: str, mesh) -> list[GraphXfer]:
         kw = {}
         if "activation" in rule:
             act = rule["activation"].strip().lower()
-            if act not in acts:
+            if act not in _ACT_NAMES:
                 raise ValueError(
                     f"unknown activation {rule['activation']!r}; have "
-                    f"{sorted(acts)}")
-            kw["activation"] = acts[act]
+                    f"{sorted(_ACT_NAMES)}")
+            kw["activation"] = _ACT_NAMES[act]
         xfers.append(_GENERATORS[gen](int(rule.get("degree", default_deg)),
                                       **kw))
     return xfers

@@ -158,6 +158,18 @@ class UnitySearch:
                     tuple(ws),
                     psum_axes=(AXIS_MODEL,),
                 ))
+        elif node.op_type == OT.OP_CONV2D and allow_attr and ndim == 4:
+            # channel/attribute-parallel conv (NCHW dim 1 over `model`,
+            # OIHW kernel dim 0 sharded) — the conv sibling of tp_attn
+            p = node.params
+            if p.out_channels % self.model_deg == 0:
+                assign = list(_dp_assign(ndim, batch_ok,
+                                         batch_axes=self.batch_axes))
+                assign[1] = (AXIS_MODEL,)
+                ws = [("kernel", PartitionSpec(AXIS_MODEL, None, None, None))]
+                if p.use_bias:
+                    ws.append(("bias", PartitionSpec(AXIS_MODEL)))
+                out.append(NodeConfig("tp_conv", tuple(assign), tuple(ws)))
         elif node.op_type == OT.OP_EXPERTS and allow_attr:
             p = node.params
             if p.n % self.model_deg == 0:
@@ -177,6 +189,15 @@ class UnitySearch:
                                batch_axes=self.batch_axes),
                     (("kernel", PartitionSpec(None, AXIS_MODEL)),),
                 ))
+        elif node.op_type in (OT.OP_POOL2D, OT.OP_BATCHNORM) and ndim == 4:
+            # channel passthrough so a tp_conv chain can stay sharded on
+            # NCHW dim 1 between conv pairs
+            dims = node.outputs[0].shape.dims
+            if dims[1].size % self.model_deg == 0:
+                assign = list(_dp_assign(ndim, batch_ok,
+                                         batch_axes=self.batch_axes))
+                assign[1] = (AXIS_MODEL,)
+                out.append(NodeConfig("chan", tuple(assign)))
         elif node.op_type in _FEATURE_ELEMENTWISE and ndim > 1:
             # pass-through configs so TP activations can stay sharded
             # across elementwise/norm ops between a col/row pair
@@ -285,7 +306,10 @@ class UnitySearch:
         if cfg.name == "tp_row" and dst_idx == 0:
             return _dp_assign(ndim, True, last_axes=(AXIS_MODEL,),
                               batch_axes=self.batch_axes)
-        if cfg.name in ("dp", "tp_col", "tp_attn", "ep") and dst_idx == 0:
+        if (cfg.name in ("dp", "tp_col", "tp_attn", "tp_conv", "ep")
+                and dst_idx == 0):
+            # tp_conv included: an O-sharded kernel consumes the FULL input
+            # channels, so a chan-sharded producer pays a real all-gather
             return _dp_assign(ndim, True, batch_axes=self.batch_axes)
         return None
 
