@@ -22,9 +22,11 @@ from .machine import DEFAULT_AXES, MeshShape
 
 # Flags parsed for reference-CLI parity whose mechanics have no TPU analog;
 # passing them warns loudly instead of silently doing nothing.
+# (--search-overlap-backward-update is NOT here: it switches the cost
+# model's gradient-sync overlap semantics, cost_model._MakespanAccum.)
 _PARITY_ONLY_FLAGS = frozenset({
     "--simulator-workspace-size", "--segment-size", "--max-num-segments",
-    "--search-overlap-backward-update", "--enable-propagation",
+    "--enable-propagation",
 })
 
 
@@ -100,10 +102,13 @@ class FFConfig:
         argv = sys.argv[1:]
         self.parse_args(argv)
         try:
-            if self.num_nodes == 1 and jax.process_count() > 1:
+            if (self.num_nodes == 1
+                    and not getattr(self, "_nodes_explicit", False)
+                    and jax.process_count() > 1):
                 # zero-config multi-controller runs (MULTIHOST.md): one
                 # process per host, so the fleet's node count is the
-                # process count unless --nodes overrode it
+                # process count; an explicit --nodes (even --nodes 1)
+                # always wins
                 self.num_nodes = jax.process_count()
         except Exception:
             pass
@@ -237,6 +242,7 @@ class FFConfig:
                 self.enable_substitutions = True
             elif a == "--nodes":
                 self.num_nodes = int(val())
+                self._nodes_explicit = True
             elif a == "-ll:gpu" or a == "-ll:tpu" or a == "--workers-per-node":
                 self.workers_per_node = int(val())
             elif a == "-ll:cpu":

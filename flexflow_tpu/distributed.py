@@ -92,7 +92,9 @@ def broadcast_json(payload: Optional[dict], max_bytes: int = 1 << 20) -> dict:
     n = int(np.frombuffer(bytes(out[:4]), dtype=np.uint32)[0])
     data = json.loads(bytes(out[4:4 + n]).decode())
     if isinstance(data, dict) and _ERR_KEY in data:
-        raise RuntimeError(f"broadcast failed on process 0: {data[_ERR_KEY]}")
+        # the marker already carries the origin (framing error here, or a
+        # caller-supplied failure like run_search_on_host0's)
+        raise RuntimeError(data[_ERR_KEY])
     return data
 
 
@@ -113,8 +115,8 @@ def run_search_on_host0(search_fn: Callable[[], "object"]) -> dict:
         except Exception as e:
             if jax.process_count() <= 1:
                 raise
-            payload = {_ERR_KEY: f"search failed: {type(e).__name__}: {e}"}
+            payload = {_ERR_KEY: f"search failed on process 0: "
+                       f"{type(e).__name__}: {e}"}
+    # broadcast_json raises the error marker on every process in lockstep
     data = broadcast_json(payload)
-    if isinstance(data, dict) and _ERR_KEY in data:
-        raise RuntimeError(data[_ERR_KEY])
     return Strategy.from_json(data).overrides

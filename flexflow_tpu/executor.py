@@ -67,9 +67,23 @@ class Executor:
         # nodes between the real softmax and the marked logits node; walk
         # back through value-preserving parallel ops so the loss doesn't
         # re-apply log-softmax to probabilities after such a rewrite.
-        self.last_op_is_softmax = (
-            _terminal_compute_op(graph, logits_node).op_type == OT.OP_SOFTMAX
-        )
+        terminal = _terminal_compute_op(graph, logits_node)
+        self.last_op_is_softmax = terminal.op_type == OT.OP_SOFTMAX
+        # AggregateSpec emits per-token-copy rows (k*b, dim) in copy-major
+        # order; labels must be replicated k× to score every expert's
+        # prediction (the reference replicates the label tensor at compile
+        # when the final op is OP_AGG_SPEC, model.cc:2875). A trailing
+        # softmax doesn't change the row count — look through it.
+        self.label_replication = 1
+        spec_probe = terminal
+        if spec_probe.op_type == OT.OP_SOFTMAX:
+            edges = graph.in_edges[spec_probe.guid]
+            if edges:
+                e = sorted(edges, key=lambda e: e.dst_idx)[0]
+                spec_probe = _terminal_compute_op(graph, graph.nodes[e.src])
+        if spec_probe.op_type == OT.OP_AGG_SPEC and spec_probe.inputs:
+            self.label_replication = (
+                spec_probe.inputs[0].shape.logical_shape[1])
         # Mixed precision (config.py): compute_dtype != None → bf16/fp16
         # activations with fp32 master weights; matmul_dtype → MXU input cast
         # for fp32 matmuls (tensor-op math analog).
@@ -108,6 +122,7 @@ class Executor:
         materialized. aux carries (logits, new_state, ce_sum): ce_sum is the
         reusable sparse-CE sum for Metrics (None for non-SCCE losses)."""
         xc = self._cast_compute(x_inputs)
+        labels = self.expand_labels(labels)
 
         def loss_fn(p):
             logits, new_state, aux = self._apply(
@@ -119,6 +134,16 @@ class Executor:
             return l + aux, (logits, new_state, ce_sum)
 
         return loss_fn
+
+    def expand_labels(self, labels):
+        """Replicate labels k× for an AggregateSpec terminal (copy-major,
+        matching _agg_spec_forward's (k*b, dim) row order) — the
+        model.cc:2875 label replication."""
+        k = self.label_replication
+        if k <= 1:
+            return labels
+        reps = (k,) + (1,) * (labels.ndim - 1)
+        return jnp.tile(labels, reps)
 
     def _restore_state_dtypes(self, new_state):
         """Non-trainable state (running stats) is kept fp32 across steps so
@@ -238,7 +263,7 @@ class Executor:
                 grads, params, opt_slots, step
             )
             counters = self.metrics.compute(
-                counters, logits, labels,
+                counters, logits, self.expand_labels(labels),
                 from_logits=not self.last_op_is_softmax, scce_sum=ce_sum,
             )
             return new_params, new_state, new_slots, step + 1, counters, lval
@@ -254,7 +279,7 @@ class Executor:
                 self._cast_compute(x_inputs), training=False, rng=None,
             )
             counters = self.metrics.compute(
-                counters, logits, labels,
+                counters, logits, self.expand_labels(labels),
                 from_logits=not self.last_op_is_softmax,
             )
             return counters

@@ -903,7 +903,7 @@ class FFModel:
         self._grads = grads
         self._cached_logits = logits
         self._counters = self.metrics.compute(
-            self._counters, logits, labels,
+            self._counters, logits, self.executor.expand_labels(labels),
             from_logits=not self.executor.last_op_is_softmax,
             scce_sum=ce_sum,
         )

@@ -203,17 +203,35 @@ def _cache_weights(p: CacheParams, in_shapes):
     return [
         WeightSpec(
             "cached", in_shapes[0], p.data_type, "zeros", trainable=False
-        )
+        ),
+        # staleness score of the cached activation (cache.h:14-65's
+        # score function, kept on-device as a non-trainable stat)
+        WeightSpec("score", (), DataType.DT_FLOAT, "zeros",
+                   trainable=False),
     ]
+
+
+def cache_score(x, cached) -> jnp.ndarray:
+    """Staleness of `cached` w.r.t. the live activation `x` — the
+    reference's CacheScore (cache.h:14-65, cache.cu score kernel): relative
+    moving difference, 0 = identical, →1 = fully drifted. RecompileState
+    triggers read this to decide cache invalidation / re-optimization
+    (moe.cc:180-204's experiment)."""
+    xf = x.astype(jnp.float32)
+    cf = cached.astype(jnp.float32)
+    num = jnp.sum(jnp.abs(xf - cf))
+    den = jnp.sum(jnp.abs(xf)) + 1e-8
+    return jnp.minimum(num / den, 1.0)
 
 
 def _cache_forward(p: CacheParams, inputs, weights, state, ctx):
     (x,) = inputs
     state = dict(state or {})
     if ctx.training:
-        # training: pass through and refresh the cache (reference
-        # cache_update task); staleness scoring is host-side via
-        # RecompileState triggers.
+        # training: score the previous cache against the live batch, then
+        # pass through and refresh (reference cache_update task); the score
+        # is exposed in op state for RecompileState triggers.
+        state["score"] = cache_score(x, weights["cached"])
         state["cached"] = x.astype(jnp.dtype(weights["cached"].dtype))
         return [x], state
     return [weights["cached"].astype(x.dtype)], state

@@ -213,24 +213,37 @@ class _MakespanAccum:
     strategy evaluation, then evaluates the makespan. Shared by both search
     evaluators so neither prices a branchy graph as a serial sum. Each
     node's comm is tagged with the ICI axis it occupies so same-axis comm
-    serializes (see graph_makespan)."""
+    serializes (see graph_makespan).
 
-    def __init__(self):
+    `overlap_sync` implements --search-overlap-backward-update (reference
+    config.h:search_overlap_backward_update): gradient-allreduce time
+    (passed via `sync=`) then overlaps other nodes' compute instead of
+    serializing on its own node's critical path — it still occupies its ICI
+    axis, so the per-axis link-occupancy bound keeps it honest."""
+
+    def __init__(self, overlap_sync: bool = False):
         self.compute: list[float] = []
         self.comm: list[float] = []
         self.axis: list[int] = []
         self.idx: dict[int, int] = {}  # node guid -> task index
         self._axis_ids: dict[str, int] = {}
+        self.overlap_sync = overlap_sync
+        self._sync_by_axis: dict[int, float] = {}
 
-    def add(self, guid: int, compute: float, comm: float, comm_axes=()):
+    def add(self, guid: int, compute: float, comm: float, comm_axes=(),
+            sync: float = 0.0):
         self.idx[guid] = len(self.compute)
         self.compute.append(compute)
-        self.comm.append(comm)
         ax = -1
         for name in comm_axes:
             ax = self._axis_ids.setdefault(name, len(self._axis_ids))
             break  # attribute to the first (dominant) axis
         self.axis.append(ax)
+        if self.overlap_sync and sync > 0.0:
+            self._sync_by_axis[ax] = self._sync_by_axis.get(ax, 0.0) + sync
+            self.comm.append(comm)
+        else:
+            self.comm.append(comm + sync)
 
     def makespan(self, in_edges) -> float:
         src, dst = [], []
@@ -242,8 +255,18 @@ class _MakespanAccum:
                     dst.append(i)
         if not self.compute:
             return 0.0
-        return graph_makespan(self.compute, self.comm, src, dst,
-                              axis=self.axis)
+        out = graph_makespan(self.compute, self.comm, src, dst,
+                             axis=self.axis)
+        if self._sync_by_axis:
+            # overlapped gradient sync: bounded by per-axis link occupancy
+            # (path comm on the same axis shares the links)
+            per_axis_comm: dict[int, float] = {}
+            for ax, c in zip(self.axis, self.comm):
+                if ax >= 0:
+                    per_axis_comm[ax] = per_axis_comm.get(ax, 0.0) + c
+            for ax, s in self._sync_by_axis.items():
+                out = max(out, s + per_axis_comm.get(ax, 0.0))
+        return out
 
 
 class CostModel:

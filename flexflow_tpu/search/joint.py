@@ -289,7 +289,7 @@ def joint_graph_optimize(
         if config.substitution_json_path:
             _xfers = load_rule_collection(config.substitution_json_path, mesh)
         else:
-            _xfers = generate_all_pcg_xfers(mesh, config)
+            _xfers = generate_all_pcg_xfers(mesh, config, graph)
     cache = _segment_cache if _segment_cache is not None else {}
     budget = config.search_budget or 16
     alpha = config.search_alpha

@@ -250,6 +250,18 @@ class GraphXfer:
             if marks:
                 nn._markers = getattr(nn, "_markers", frozenset()) | marks
         propagate_parallel_state(new_g)
+        # dst compute ops built fresh (no match_src, e.g. the fused Experts
+        # node) declare their weights from the propagated input shapes; the
+        # reference rebuilds operators from the rewritten PCG the same way
+        # (model.cc:2830-2872)
+        for dx, n in dst_node.items():
+            if n.weight_specs or n.op_type in _PARALLEL:
+                continue
+            try:
+                in_shapes = [pt.shape.logical_shape for pt in n.inputs]
+                n.weight_specs = n.op_def.weights(n.params, in_shapes)
+            except NotImplementedError:
+                pass
         return new_g
 
 
@@ -633,7 +645,8 @@ def assign_axes_from_degrees(graph: Graph, mesh):
 
 # ------------------------------------------------------------- graph costing
 
-def evaluate_graph(graph: Graph, mesh, cm: CostModel) -> tuple[float, float]:
+def evaluate_graph(graph: Graph, mesh, cm: CostModel,
+                   overlap_sync: bool = False) -> tuple[float, float]:
     """(time, per-chip memory) of a rewritten PCG: compute ops through the
     cost model on their emitted assignments; parallel ops priced as the
     collectives they lower to (the reference prices them as partition-copy
@@ -643,7 +656,7 @@ def evaluate_graph(graph: Graph, mesh, cm: CostModel) -> tuple[float, float]:
     from .cost_model import _MakespanAccum
 
     assign_axes_from_degrees(graph, mesh)
-    acc = _MakespanAccum()
+    acc = _MakespanAccum(overlap_sync=overlap_sync)
     mem = 0.0
     machine = cm.machine
     for node in graph.topo_order():
@@ -661,7 +674,7 @@ def evaluate_graph(graph: Graph, mesh, cm: CostModel) -> tuple[float, float]:
             node, [_logical_assignment(pt) for pt in node.outputs],
             dict(node.weight_axes), in_shapes, in_assigns)
         acc.add(node.guid, cmx.forward_time + cmx.backward_time,
-                cmx.sync_time + cmx.comm_time,
+                cmx.comm_time, sync=cmx.sync_time,
                 comm_axes=(AXIS_DATA,) if cmx.sync_time > 0 else ())
         mem += cmx.memory
     return acc.makespan(graph.in_edges), mem
@@ -846,8 +859,12 @@ def create_partition_concat_combine(degree: int) -> GraphXfer:
     the reference generates per num_inputs too)."""
     x = GraphXfer(f"partition_concat_combine[deg={degree}]")
     a, b = x.new_input(0), x.new_input(1)
+    # arity constraint is load-bearing: the matcher only checks the node has
+    # AT LEAST as many inputs as the pattern, so without it a 3-input
+    # concat would match and the rewrite would silently drop operands
     cat1 = OpX(OT.OP_CONCAT, (a, b),
-               constraints=(lambda n: n.params.axis != 0,))
+               constraints=(lambda n: n.params.axis != 0,
+                            lambda n: n.params.n == 2,))
     rep1 = OpX(OT.OP_REPARTITION, (a,),
                make_params=lambda m: RepartitionParams(0, degree))
     rep2 = OpX(OT.OP_REPARTITION, (b,),
@@ -876,6 +893,62 @@ def create_partition_embedding_combine(degree: int) -> GraphXfer:
     x.src_ops = [e1]
     x.dst_ops = [rep, e2, comb]
     x.map_output(e1.outputs[0], comb.outputs[0])
+    return x
+
+
+def create_fuse_moe_trio(n: int) -> GraphXfer:
+    """Fuse the reference-parity unfused MoE trio — Group_by → n per-expert
+    Dense → Aggregate (src/ops/moe.cc:20-50) — into the single stacked
+    Experts op, whose (n, d, h) kernel shards over the expert/model mesh
+    axis (UnitySearch's "ep" config). This is how expert parallelism
+    reaches models built through the unfused API: the reference gives the
+    trio attribute-parallel machine views (examples/cpp/mixture_of_experts);
+    under GSPMD per-expert ops can't be "placed", so the capability is
+    delivered by this rewrite + a sharding instead.
+
+    Expert weights are re-initialized by the rewrite (the reference also
+    rebuilds operators from the optimized PCG at compile, model.cc:2830+).
+    """
+    from ..ops.moe import ExpertsParams
+
+    x = GraphXfer(f"fuse_moe_trio[n={n}]")
+    data = x.new_input(0)
+    values = x.new_input(1)
+    assign = x.new_input(2)
+    probs = x.new_input(3)
+
+    gb = OpX(OT.OP_GROUP_BY, (data, assign), num_outputs=n,
+             constraints=(lambda node: node.params.n == n,))
+    linears = [
+        OpX(OT.OP_LINEAR, (TensorX(gb, i),),
+            constraints=(lambda node: node.params.use_bias,))
+        for i in range(n)
+    ]
+    agg = OpX(OT.OP_AGGREGATE, tuple(
+        [values, assign, assign, probs] + [l.outputs[0] for l in linears]))
+
+    def experts_params(m):
+        gbp = m[gb].params
+        aggp = m[agg].params
+        lps = [m[l].params for l in linears]
+        hidden = lps[0].out_channels
+        act = lps[0].activation
+        if any(p.out_channels != hidden or p.activation != act
+               for p in lps):
+            raise ValueError("fuse_moe_trio: experts disagree on shape/act")
+        act_name = {ActiMode.AC_MODE_RELU: "relu",
+                    ActiMode.AC_MODE_GELU: "gelu",
+                    ActiMode.AC_MODE_NONE: "none"}.get(act)
+        if act_name is None:
+            raise ValueError(f"fuse_moe_trio: unsupported activation {act}")
+        return ExpertsParams(n, hidden, gbp.alpha, aggp.lambda_bal,
+                             use_bias=True, activation=act_name)
+
+    experts = OpX(OT.OP_EXPERTS, (data, values, assign),
+                  make_params=experts_params)
+    x.src_ops = [gb] + linears + [agg]
+    x.dst_ops = [experts]
+    x.map_output(agg.outputs[0], experts.outputs[0])
     return x
 
 
@@ -928,14 +1001,25 @@ _GENERATORS = {
     "partition_embedding_combine":
         lambda deg, **kw: create_partition_embedding_combine(deg),
     "linear_relu_merge": lambda deg, **kw: create_linear_relu_merge(),
+    "fuse_moe_trio": lambda deg, **kw: create_fuse_moe_trio(
+        int(kw.get("n", deg))),
 }
 
 
-def generate_all_pcg_xfers(mesh, config) -> list[GraphXfer]:
+def generate_all_pcg_xfers(mesh, config, graph: Optional[Graph] = None
+                           ) -> list[GraphXfer]:
     """The rule set for a mesh (generate_all_pcg_xfers,
     substitution.cc:1726): one instance of each family per usable parallel
-    degree (mesh axis sizes play the role of workersPerNode divisors)."""
+    degree (mesh axis sizes play the role of workersPerNode divisors).
+    When the graph is given, data-driven families are added too (one
+    fuse_moe_trio per distinct Group_by expert count)."""
     xfers: list[GraphXfer] = [create_linear_relu_merge()]
+    if graph is not None:
+        seen_n = set()
+        for node in graph.topo_order():
+            if node.op_type == OT.OP_GROUP_BY and node.params.n not in seen_n:
+                seen_n.add(node.params.n)
+                xfers.append(create_fuse_moe_trio(node.params.n))
     sizes = dict(mesh.shape)
     model_deg = sizes.get(AXIS_MODEL, 1)
     data_deg = sizes.get(AXIS_DATA, 1)
@@ -1139,6 +1223,8 @@ def load_rule_collection(path: str, mesh) -> list[GraphXfer]:
                     f"unknown activation {rule['activation']!r}; have "
                     f"{sorted(_ACT_NAMES)}")
             kw["activation"] = _ACT_NAMES[act]
+        if "n" in rule:
+            kw["n"] = int(rule["n"])
         xfers.append(_GENERATORS[gen](int(rule.get("degree", default_deg)),
                                       **kw))
     return xfers
@@ -1200,6 +1286,7 @@ def base_optimize(
     budget: int = 16,
     alpha: float = 1.2,
     hbm_cap: Optional[float] = None,
+    overlap_sync: bool = False,
 ) -> tuple[Graph, float]:
     """Substitution-only search: candidates priced through the fixed
     degree-derived axis assignment (evaluate_graph) with per-chip HBM
@@ -1207,7 +1294,7 @@ def base_optimize(
     prices the same candidates with the full placement DP instead."""
 
     def cost_of(g: Graph):
-        t, mem = evaluate_graph(g, mesh, cm)
+        t, mem = evaluate_graph(g, mesh, cm, overlap_sync=overlap_sync)
         cap = hbm_cap if hbm_cap is not None else cm.machine.chip.hbm_bytes
         if mem > cap:
             t *= 1.0 + 10.0 * (mem - cap) / cap
@@ -1231,8 +1318,9 @@ def graph_optimize(graph: Graph, mesh, config,
     if config.substitution_json_path:
         xfers = load_rule_collection(config.substitution_json_path, mesh)
     else:
-        xfers = generate_all_pcg_xfers(mesh, config)
+        xfers = generate_all_pcg_xfers(mesh, config, graph)
     budget = config.search_budget or 16
-    best, _ = base_optimize(graph, mesh, cm, xfers, budget=budget,
-                            alpha=config.search_alpha)
+    best, _ = base_optimize(
+        graph, mesh, cm, xfers, budget=budget, alpha=config.search_alpha,
+        overlap_sync=config.search_overlap_backward_update)
     return best

@@ -222,7 +222,8 @@ class UnitySearch:
         branches (DLRM towers) are priced at max(paths). `only` restricts
         accumulation to a guid subset (segment costing): configs outside it
         still feed reshard classification but don't contribute cost."""
-        acc = _MakespanAccum()
+        acc = _MakespanAccum(
+            overlap_sync=self.config.search_overlap_backward_update)
         mem = 0.0
         for node in self.order:
             if node.op_type in (OT.OP_INPUT, OT.OP_WEIGHT, OT.OP_NOOP):
@@ -292,25 +293,35 @@ class UnitySearch:
                 comm_axes = (AXIS_DATA,)  # gradient allreduce rides `data`
             acc.add(node.guid,
                     cm.forward_time + cm.backward_time,
-                    cm.sync_time + cm.comm_time + reshard + psum,
-                    comm_axes=comm_axes)
+                    cm.comm_time + reshard + psum,
+                    comm_axes=comm_axes, sync=cm.sync_time)
             mem += cm.memory
         return acc.makespan(self.graph.in_edges), mem
 
     def _expected_input(self, node, cfg, dst_idx, ndim):
-        """The input spec a config consumes (None = producer's choice OK)."""
+        """The input spec a config consumes (None = producer's choice OK).
+
+        Applies to EVERY input edge, not just input 0 — multi-input ops
+        (aggregate's expert outputs, element-binary towers, concat) must
+        pay the reshard their secondary operands need, otherwise e.g. a
+        feature-sharded expert output flows into a dp aggregate for free
+        and the search underprices unfused plans."""
         if cfg.in_assigns is not None:  # rewrite-pinned: degree-derived
             if dst_idx < len(cfg.in_assigns):
                 return cfg.in_assigns[dst_idx]
             return None
-        if cfg.name == "tp_row" and dst_idx == 0:
-            return _dp_assign(ndim, True, last_axes=(AXIS_MODEL,),
-                              batch_axes=self.batch_axes)
-        if (cfg.name in ("dp", "tp_col", "tp_attn", "tp_conv", "ep")
-                and dst_idx == 0):
+        if cfg.name == "tp_row":
+            if dst_idx == 0:
+                return _dp_assign(ndim, True, last_axes=(AXIS_MODEL,),
+                                  batch_axes=self.batch_axes)
+            return _dp_assign(ndim, True, batch_axes=self.batch_axes)
+        if cfg.name in ("dp", "tp_col", "tp_attn", "tp_conv", "ep"):
             # tp_conv included: an O-sharded kernel consumes the FULL input
             # channels, so a chan-sharded producer pays a real all-gather
             return _dp_assign(ndim, True, batch_axes=self.batch_axes)
+        if cfg.name in ("feat", "chan") and len(cfg.out_assign) == ndim:
+            # pass-through configs consume their own (sharded) layout
+            return cfg.out_assign
         return None
 
     # ---------------------------------------------------- bottleneck DP

@@ -1,0 +1,181 @@
+"""MoE parity tests: expert parallelism for the unfused reference trio via
+the fuse_moe_trio rewrite (examples/cpp/mixture_of_experts attribute-parallel
+views recast), AggregateSpec label replication (model.cc:2875) trained e2e,
+and Cache staleness scoring (cache.h:14-65)."""
+
+import sys
+
+import numpy as np
+import pytest
+
+
+def _config(mesh_axes, batch=16, argv=()):
+    sys.argv = ["test"] + list(argv)
+    from flexflow_tpu import FFConfig
+
+    config = FFConfig()
+    config.mesh_axis_sizes = mesh_axes
+    config.batch_size = batch
+    return config
+
+
+def test_fuse_moe_trio_rewrite():
+    """The rewrite matches the unfused group_by → dense×n → aggregate trio
+    and produces a stacked Experts node with the right params."""
+    from flexflow_tpu import FFModel
+    from flexflow_tpu.fftype import OperatorType as OT
+    from flexflow_tpu.models import MoeConfig, build_moe
+    from flexflow_tpu.search.substitution import create_fuse_moe_trio
+    from tests.test_joint_search import _pcg_of
+
+    config = _config((2, 4, 1, 1), batch=16)
+    ff = FFModel(config)
+    mc = MoeConfig(num_exp=4, num_select=2, in_dim=32, num_classes=8)
+    build_moe(ff, mc, batch_size=16, fused=False)
+    g = _pcg_of(ff)
+    assert any(n.op_type == OT.OP_GROUP_BY for n in g.topo_order())
+
+    xfer = create_fuse_moe_trio(4)
+    matches = xfer.find_matches(g)
+    assert matches, "fuse_moe_trio found no match on the unfused MoE"
+    ng = xfer.apply(g, matches[0])
+    types = [n.op_type for n in ng.topo_order()]
+    assert OT.OP_EXPERTS in types
+    assert OT.OP_GROUP_BY not in types and OT.OP_AGGREGATE not in types
+    exp = next(n for n in ng.topo_order() if n.op_type == OT.OP_EXPERTS)
+    assert exp.params.n == 4
+    assert exp.params.hidden_size == 8  # expert dense out = num_classes
+    assert exp.params.alpha == mc.alpha
+    assert exp.params.lambda_bal == mc.lambda_bal
+    # the fresh Experts node declares its stacked weights
+    names = {ws.name for ws in exp.weight_specs}
+    assert "kernel" in names
+
+
+def test_unfused_moe_search_reaches_expert_parallel():
+    """Joint search on the UNFUSED MoE: the fuse rewrite fires and the
+    stacked kernel can shard over the model axis — EP for the
+    reference-parity path."""
+    from flexflow_tpu import FFModel
+    from flexflow_tpu.fftype import OperatorType as OT
+    from flexflow_tpu.models import MoeConfig, build_moe
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.joint import joint_graph_optimize
+    from flexflow_tpu.search.machine_model import machine_model_for_mesh
+    from flexflow_tpu.machine import build_mesh
+    from tests.test_joint_search import _pcg_of
+
+    config = _config((2, 4, 1, 1), batch=64,
+                     argv=["--budget", "8", "--enable-attribute-parallel",
+                           "--search-overlap-backward-update"])
+    ff = FFModel(config)
+    # large experts so the fused+sharded plan wins on cost
+    mc = MoeConfig(num_exp=4, num_select=2, in_dim=512, num_classes=512,
+                   alpha=2.0)
+    build_moe(ff, mc, batch_size=64, fused=False)
+    g = _pcg_of(ff)
+    mesh = build_mesh(config.mesh_shape())
+    cm = CostModel(machine_model_for_mesh(mesh))
+    best_g, choice, us = joint_graph_optimize(g, mesh, config, cm)
+    experts = [n for n in best_g.topo_order() if n.op_type == OT.OP_EXPERTS]
+    assert experts, "search did not fuse the MoE trio"
+    cfg = choice.get(experts[0].guid)
+    assert cfg is not None and cfg.name == "ep", (
+        f"expected ep sharding on the fused Experts, got "
+        f"{cfg.name if cfg else None}")
+
+
+def test_unfused_moe_trains_through_search():
+    """8-device dryrun: unfused MoE compiled through the joint search (fuse
+    rewrite live) executes a training epoch and learns."""
+    from flexflow_tpu import FFModel, LossType, MetricsType, SGDOptimizer
+    from flexflow_tpu.models import MoeConfig, build_moe
+
+    config = _config((2, 4, 1, 1), batch=32,
+                     argv=["--budget", "6", "--enable-attribute-parallel"])
+    ff = FFModel(config)
+    mc = MoeConfig(num_exp=4, num_select=2, in_dim=32, num_classes=10,
+                   alpha=2.0)
+    build_moe(ff, mc, batch_size=32, fused=False)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    rs = np.random.RandomState(0)
+    c = rs.randn(10, 32) * 3
+    y = rs.randint(0, 10, 512)
+    xs = (c[y] + rs.randn(512, 32)).astype(np.float32)
+    ff.fit(xs, y.reshape(-1, 1).astype(np.int32), epochs=3)
+    assert ff.get_perf_metrics().get_accuracy() >= 0.6
+
+
+def _build_agg_spec_model(ff, n=4, k=2, in_dim=32, classes=8, batch=16):
+    from flexflow_tpu import ActiMode
+
+    x = ff.create_tensor((batch, in_dim), name="input")
+    gate = ff.dense(x, n, ActiMode.AC_MODE_RELU, name="gate")
+    probs = ff.softmax(gate, name="gate_sm")
+    vals, assign = ff.top_k(probs, k)
+    experts_in = ff.group_by(x, assign, n, 2.0, name="gb")
+    outs = [ff.dense(ei, classes, ActiMode.AC_MODE_RELU, name=f"exp{i}")
+            for i, ei in enumerate(experts_in)]
+    t = ff.aggregate_spec([vals, assign, assign, probs] + outs, n,
+                          name="agg_spec")
+    return ff.softmax(t, name="sm")
+
+
+def test_aggregate_spec_trains_with_replicated_labels():
+    """AggregateSpec as the output head: logits are (k*b, classes) and the
+    executor replicates labels k× (model.cc:2875) so the SCCE loss and
+    metrics line up; training runs and improves."""
+    from flexflow_tpu import FFModel, LossType, MetricsType, SGDOptimizer
+
+    k, batch = 2, 16
+    config = _config((2, 1, 1, 1), batch=batch)
+    ff = FFModel(config)
+    _build_agg_spec_model(ff, n=4, k=k, batch=batch)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    assert ff.executor.label_replication == k
+
+    rs = np.random.RandomState(0)
+    c = rs.randn(8, 32) * 3
+    y = rs.randint(0, 8, 256)
+    xs = (c[y] + rs.randn(256, 32)).astype(np.float32)
+    ff.fit(xs, y.reshape(-1, 1).astype(np.int32), epochs=3)
+    m = ff.get_perf_metrics()
+    # every batch contributes k*b samples
+    assert m.train_all == 3 * 256 * k
+    assert m.get_accuracy() >= 0.5
+
+
+def test_cache_staleness_score():
+    """Cache scores its cached activation against the live batch: fully
+    stale (1.0) on the first step, fresh (0.0) when the same batch repeats
+    (cache.h:14-65 score semantics)."""
+    from flexflow_tpu import FFModel, LossType, SGDOptimizer
+
+    config = _config((1, 1, 1, 1), batch=8)
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 16), name="input")
+    t = ff.cache(x, num_batches=4, name="cache0")
+    t = ff.dense(t, 4, name="head")
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(8, 16).astype(np.float32)
+    ys = rs.randn(8, 4).astype(np.float32)
+    step = ff.executor.build_train_step()
+    import jax
+
+    state = (ff._params, ff._state, ff._opt_slots, ff._step, ff._counters)
+    batch = ff._make_batch({"input": xs}, ys)
+    out = step(*state, jax.random.key(0), batch)
+    s1 = float(out[1]["cache0"]["score"])
+    assert s1 == pytest.approx(1.0), "empty cache must score fully stale"
+    out2 = step(out[0], out[1], out[2], out[3], out[4],
+                jax.random.key(1), batch)
+    s2 = float(out2[1]["cache0"]["score"])
+    assert s2 == pytest.approx(0.0, abs=1e-5), (
+        "repeating the same batch must score fresh")

@@ -212,9 +212,9 @@ def test_two_tower_costed_as_makespan_not_sum():
     orig = _MakespanAccum.add
 
     class Spy(_MakespanAccum):
-        def add(self, guid, compute, comm, comm_axes=()):
-            rows.append((guid, compute, comm))
-            orig(self, guid, compute, comm, comm_axes=comm_axes)
+        def add(self, guid, compute, comm, comm_axes=(), sync=0.0):
+            rows.append((guid, compute, comm + sync))
+            orig(self, guid, compute, comm, comm_axes=comm_axes, sync=sync)
 
     import flexflow_tpu.search.unity as unity_mod
     saved = unity_mod._MakespanAccum
