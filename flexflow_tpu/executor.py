@@ -164,6 +164,8 @@ class Executor:
         in model.cc map_weight + initializer tasks)."""
         params, state = {}, {}
         for node in self.order:
+            if getattr(node, "weight_source", None):
+                continue  # tied weights live under the source node's name
             p, s = {}, {}
             for i, ws in enumerate(node.weight_specs):
                 init = node.initializers.get(
@@ -206,9 +208,12 @@ class Executor:
             for e in self.graph.in_edges[node.guid]:
                 ins[e.dst_idx] = vals[(e.src, e.src_idx)]
 
+            # tied weights read the source node's parameter set; autodiff
+            # then sums every use's gradient into that one set
+            wsrc = getattr(node, "weight_source", None) or node.name
             weights = {}
-            weights.update(params.get(node.name, {}))
-            weights.update(new_state.get(node.name, {}))
+            weights.update(params.get(wsrc, {}))
+            weights.update(new_state.get(wsrc, {}))
             ctx = OpContext(
                 training=training,
                 rng=_stable_fold(rng, node.name) if rng is not None else None,

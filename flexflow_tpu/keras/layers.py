@@ -98,12 +98,20 @@ class Dense(Layer):
     def compute_output_shape(self, in_shapes):
         return tuple(in_shapes[0][:-1]) + (self.units,)
 
-    def materialize(self, ff, inputs):
+    def materialize(self, ff, inputs, shared_op=None):
+        """`shared_op` is a sharing FLAG from BaseModel.compile (truthy on
+        re-calls of the same layer object): the tie anchors to the first
+        call's dense op recorded on the layer, since the externally visible
+        output may be a trailing softmax tensor."""
         act = _ACTIVATIONS.get(self.activation, ActiMode.AC_MODE_NONE)
         softmax_after = act == "softmax"
+        tie = (getattr(self, "_ff_dense_out", None) if shared_op else None)
         t = ff.dense(inputs[0], self.units,
                      ActiMode.AC_MODE_NONE if softmax_after else act,
-                     use_bias=self.use_bias, name=self.name)
+                     use_bias=self.use_bias, name=self.name,
+                     shared_op=tie)
+        if getattr(self, "_ff_dense_out", None) is None:
+            self._ff_dense_out = t
         if softmax_after:
             t = ff.softmax(t, name=f"{self.name}_softmax")
         return t

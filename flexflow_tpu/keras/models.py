@@ -60,19 +60,33 @@ class BaseModel:
             dtype = (DataType.DT_INT32 if "int" in str(kt.dtype)
                      else DataType.DT_FLOAT)
             mapping[kt.name] = ff.create_tensor(shape, dtype, name=kt.name)
+        import inspect
+
         call_counts: dict = {}
         for kt in self._topo_calls():
             layer = kt.layer
+            # stale anchors from a previous compile must not leak into this
+            # FFModel
+            if call_counts.get(id(layer), 0) == 0:
+                layer._ff_dense_out = None
             ins = [mapping[t.name] for t in kt.call_inputs]
             n = call_counts.get(id(layer), 0)
             call_counts[id(layer)] = n + 1
             if n > 0:
                 # shared layer called again: materialize under a unique name
-                # (NOTE: parameters are per-call, not shared — FFModel-level
-                # shared_op weight sharing is future work)
+                # tied to the first call's parameters (Keras layer-sharing
+                # semantics; reference dense/embedding shared_op). Layer
+                # types whose materialize has no shared_op parameter fall
+                # back to per-call weights — a real limitation for weighted
+                # layers other than Dense, kept visible here rather than
+                # swallowed by a broad except.
                 saved = layer.name
                 layer.name = f"{saved}_call{n}"
-                out = layer.materialize(ff, ins)
+                sig = inspect.signature(layer.materialize)
+                if "shared_op" in sig.parameters:
+                    out = layer.materialize(ff, ins, shared_op=True)
+                else:
+                    out = layer.materialize(ff, ins)
                 layer.name = saved
             else:
                 out = layer.materialize(ff, ins)

@@ -35,6 +35,9 @@ class Layer:
         self.name = name or f"{op_type.name.lower()}_{self.layer_guid}"
         # per-weight Initializer overrides, name → Initializer
         self.initializers = initializers or {}
+        # tied weights: guid of the layer whose parameters this one reads
+        # (reference shared_op; -1 = owns its own weights)
+        self.shared_layer_guid = -1
 
     @property
     def num_inputs(self) -> int:

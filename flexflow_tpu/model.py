@@ -140,9 +140,24 @@ class FFModel:
         name: str = "",
         initializers: Optional[dict] = None,
         data_type: DataType = DataType.DT_FLOAT,
+        shared_op=None,
     ) -> Layer:
         layer = Layer(op_type, params, inputs, name=name, data_type=data_type,
                       initializers=initializers)
+        if shared_op is not None:
+            # tied weights (reference dense/embedding shared_op, model.h):
+            # this layer reads the shared layer's parameters; autodiff sums
+            # the gradients of every use into the one parameter set
+            src = getattr(shared_op, "owner_layer", shared_op)
+            if not isinstance(src, Layer):
+                raise TypeError(
+                    f"shared_op must be a Layer or one of its output "
+                    f"tensors, got {type(shared_op).__name__}")
+            if src.op_type != op_type:
+                raise ValueError(
+                    f"shared_op ties a {op_type.name} layer to a "
+                    f"{src.op_type.name} layer")
+            layer.shared_layer_guid = src.layer_guid
         op_def = get_op_def(op_type)
         in_shapes = [t.dims for t in inputs]
         out_shapes = op_def.infer_shapes(params, in_shapes)
@@ -249,7 +264,7 @@ class FFModel:
         if bias_initializer is not None:
             inits["bias"] = bias_initializer
         return self._add_layer(OT.OP_LINEAR, p, [input], name, inits,
-                               data_type).outputs[0]
+                               data_type, shared_op=shared_op).outputs[0]
 
     def conv2d(
         self,
@@ -342,7 +357,7 @@ class FFModel:
         p = EmbeddingParams(num_entries, out_dim, AggrMode(aggr), dtype)
         inits = {"kernel": kernel_initializer} if kernel_initializer else {}
         return self._add_layer(OT.OP_EMBEDDING, p, [input], name, inits,
-                               dtype).outputs[0]
+                               dtype, shared_op=shared_op).outputs[0]
 
     def gather(self, input: Tensor, index: Tensor, dim: int = 0, name: str = "") -> Tensor:
         p = GatherParams(dim)
@@ -588,17 +603,38 @@ class FFModel:
             g.add_node(node)
             tensor_to_out[t.tensor_guid] = (node, 0)
 
+        guid_to_node: dict[int, OpNode] = {}
+        self._weight_alias: dict[str, str] = {}  # tied node name -> owner
         for layer in self.layers:
             node = OpNode(layer.op_type, layer.params, name=layer.name,
                           layer_guid=layer.layer_guid,
                           initializers=layer.initializers)
             g.add_node(node)
+            guid_to_node[layer.layer_guid] = node
             for dst_idx, t_in in enumerate(layer.inputs):
                 src_node, src_idx = tensor_to_out[t_in.tensor_guid]
                 g.add_edge(src_node, node, src_idx, dst_idx)
                 node.inputs.append(src_node.outputs[src_idx])
             in_shapes = [t.dims for t in layer.inputs]
             node.weight_specs = node.op_def.weights(layer.params, in_shapes)
+            if layer.shared_layer_guid >= 0:
+                # tied weights: this node reads the source node's parameter
+                # set; the executor creates no variables for it and autodiff
+                # sums gradients across all uses (reference shared_op)
+                src = guid_to_node.get(layer.shared_layer_guid)
+                if src is None:
+                    raise ValueError(
+                        f"{layer.name}: shared_op layer must be built "
+                        f"before the layer sharing it")
+                src_shapes = {ws.name: ws.shape for ws in src.weight_specs}
+                for ws in node.weight_specs:
+                    if src_shapes.get(ws.name) != ws.shape:
+                        raise ValueError(
+                            f"{layer.name}: shared weight {ws.name!r} shape "
+                            f"{ws.shape} != source {src.name}'s "
+                            f"{src_shapes.get(ws.name)}")
+                node.weight_source = src.name
+                self._weight_alias[node.name] = src.name
             for i, t_out in enumerate(layer.outputs):
                 shape = ParallelTensorShape.from_shape(t_out.dims, t_out.dtype)
                 pt = ParallelTensor(shape, name=t_out.name)
@@ -803,6 +839,14 @@ class FFModel:
             epochs: int = -1, batch_size: int = -1, shuffle: bool = True):
         """Training loop (parity: flexflow_cffi.py:2058-2100)."""
         assert self._compiled, "call compile() before fit()"
+        if self.config.profiling and not getattr(self, "_profiled", False):
+            # --profiling: per-op kernel table, printed once per compile
+            # (the reference prints per-kernel times every launch under
+            # m->profiling, linear_kernels.cu:95-117)
+            from .profiling import print_operator_profile
+
+            print_operator_profile(self.graph)
+            self._profiled = True
         if epochs < 0:
             epochs = self.config.epochs
         if batch_size < 0:
@@ -930,10 +974,18 @@ class FFModel:
     # ------------------------------------------------ weights I/O
     # (reference ParallelTensorBase::set_tensor/get_tensor)
 
+    def _resolve_weight_owner(self, layer_name: str) -> str:
+        """Tied-weight nodes (shared_op) store no parameters of their own —
+        reads/writes go to the source layer's set (O(1) via the alias map
+        built at compile)."""
+        return getattr(self, "_weight_alias", {}).get(layer_name, layer_name)
+
     def get_weight(self, layer_name: str, weight_name: str) -> np.ndarray:
+        layer_name = self._resolve_weight_owner(layer_name)
         return np.asarray(self._params[layer_name][weight_name])
 
     def set_weight(self, layer_name: str, weight_name: str, value: np.ndarray):
+        layer_name = self._resolve_weight_owner(layer_name)
         old = self._params[layer_name][weight_name]
         self._params[layer_name][weight_name] = jax.device_put(
             jnp.asarray(value, old.dtype), old.sharding

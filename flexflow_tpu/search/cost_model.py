@@ -328,15 +328,21 @@ class CostModel:
                 tuple(d.size for d in pt.shape.dims if not d.is_replica_dim),
                 a, axis_sizes) * dtype_bytes(pt.dtype)
 
+        # tied-weight nodes (shared_op) read another node's parameters: the
+        # bytes are still touched each step, but the weight/grad/optimizer
+        # memory and the gradient allreduce are owned (and already counted)
+        # by the source node
+        tied = bool(getattr(node, "weight_source", None))
         weight_bytes = 0.0
         sync = 0.0
         for ws in node.weight_specs:
             spec = (weight_specs_assigns or {}).get(ws.name)
             w_assign = _spec_to_assignment(spec, len(ws.shape))
             wb = _shard_elems(ws.shape, w_assign, axis_sizes) * dtype_bytes(ws.dtype)
-            weight_bytes += wb
+            if not tied:
+                weight_bytes += wb
             bytes_touched += wb
-            if ws.trainable:
+            if ws.trainable and not tied:
                 # gradient allreduce over every data-ish axis the weight is
                 # NOT sharded over but its consumers' activations are
                 w_axes = _axes_of(w_assign)
@@ -346,19 +352,24 @@ class CostModel:
 
         eff_peak_t = self.machine.compute_time(shard_flops / self.mfu,
                                                bytes_touched)
-        # measured full-op time (calibrate_graph) overrides the fixed-mfu
-        # roofline; scale by the shard fraction since the measurement is of
-        # the unsharded op on one chip
+        # measured full-op (fwd, bwd) times (calibrate_graph) override the
+        # fixed-mfu roofline; scale by the shard fraction since the
+        # measurement is of the unsharded op on one chip
         calib = self._calibration.get(
             _params_key(node, tuple(tuple(s) for s in in_shapes)))
         if calib is not None:
-            fwd = calib * shard_flops / max(full_flops, 1.0)
+            cal_fwd, cal_bwd = calib
+            ratio = shard_flops / max(full_flops, 1.0)
+            fwd = cal_fwd * ratio
+            bwd = cal_bwd * ratio
         else:
             fwd = eff_peak_t
-        # rule of thumb (also the reference simulator's default): bwd ≈ 2× fwd
+            # rule of thumb (also the reference simulator's default) when
+            # unmeasured: bwd ≈ 2× fwd
+            bwd = 2.0 * fwd
         cm = CostMetrics(
             forward_time=fwd,
-            backward_time=2.0 * fwd,
+            backward_time=bwd,
             sync_time=sync,
             memory=weight_bytes * 3  # weight + grad + optimizer slot
             + _shard_elems(out_shapes[0] if out_shapes else (),
@@ -370,26 +381,54 @@ class CostModel:
 
     # -------------------------------------------------------- calibration
 
-    def calibrate(self, node, fn, example_args) -> float:
-        """Measure a jitted op on the real chip and pin its cost (the
-        Op::inner_measure_operator_cost analog: warmup + timed repeats,
-        model.cu:38-75)."""
+    def calibrate(self, node, fn, example_args) -> tuple[float, float]:
+        """Measure a jitted op on the real chip and pin its (forward,
+        backward) costs — the Op::inner_measure_operator_cost analog
+        (warmup + timed repeats, model.cu:38-75). The reference times
+        forward and backward kernels separately (linear.cc:792-925); here
+        backward = (time of value+vjp w.r.t. every float operand incl.
+        weights) − forward, so TP-vs-DP tradeoffs that hinge on backward
+        cost use a measured ratio instead of the 2× rule of thumb."""
         import time
 
         import jax
+        import jax.numpy as jnp
 
-        jf = jax.jit(fn)
-        out = jf(*example_args)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        reps = 5
-        for _ in range(reps):
-            out = jf(*example_args)
-        jax.block_until_ready(out)
-        t = (time.perf_counter() - t0) / reps
-        self._calibration[_params_key(node)] = t
+        def _timed(jitted):
+            out = jitted(*example_args)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            reps = 5
+            for _ in range(reps):
+                out = jitted(*example_args)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / reps
+
+        fwd_t = _timed(jax.jit(fn))
+        bwd_t = None
+        diff_argnums = tuple(
+            i for i, a in enumerate(example_args)
+            if jax.tree.leaves(a)
+            and all(jnp.issubdtype(leaf.dtype, jnp.floating)
+                    for leaf in jax.tree.leaves(a))
+        )
+        if diff_argnums:
+            def scalar_loss(*args):
+                return jnp.sum(fn(*args).astype(jnp.float32))
+
+            try:
+                g = jax.jit(jax.grad(scalar_loss, argnums=diff_argnums))
+                both_t = _timed(g)
+                # grad re-runs the forward; keep a sane floor when timing
+                # noise makes the subtraction go negative
+                bwd_t = max(both_t - fwd_t, 0.25 * fwd_t)
+            except Exception:
+                bwd_t = None
+        if bwd_t is None:
+            bwd_t = 2.0 * fwd_t  # non-differentiable op: rule of thumb
+        self._calibration[_params_key(node)] = (fwd_t, bwd_t)
         self._cache.clear()  # cached roofline entries are stale now
-        return t
+        return fwd_t, bwd_t
 
     def calibrate_graph(self, graph, top_k: int = 4) -> int:
         """Measure the top-K most expensive distinct ops of a PCG on the
@@ -456,15 +495,19 @@ def _op_harness(node):
                for ws in node.weight_specs}
     state = {ws.name: weights[ws.name] for ws in node.weight_specs
              if not ws.trainable}
+    trainable = {ws.name: weights[ws.name] for ws in node.weight_specs
+                 if ws.trainable}
     ctx = OpContext(training=False, rng=jax.random.key(0))
     params, op_def = node.params, node.op_def
 
-    def fn(*arrs):
-        outs, _ = op_def.forward(params, list(arrs), weights,
+    # trainable weights are the FIRST argument so calibrate can
+    # differentiate the op w.r.t. them (dW time dominates many backwards)
+    def fn(tw, *arrs):
+        outs, _ = op_def.forward(params, list(arrs), {**weights, **tw},
                                  dict(state) if state else None, ctx)
         return outs[0]
 
-    return fn, tuple(ins)
+    return fn, (trainable,) + tuple(ins)
 
 
 def _params_key(node, in_shapes=None):

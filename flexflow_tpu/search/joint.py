@@ -170,6 +170,9 @@ def _clone_basic(graph: Graph, node: OpNode) -> OpNode:
                 initializers=node.initializers)
     nn.weight_specs = list(node.weight_specs)
     nn.weight_axes = dict(node.weight_axes)
+    src = getattr(node, "weight_source", None)
+    if src:
+        nn.weight_source = src  # tied weights survive splits by name
     if node.op_type == OT.OP_INPUT:
         nn.outputs = [ParallelTensor(pt.shape, name=pt.name)
                       for pt in node.outputs]

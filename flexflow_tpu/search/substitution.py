@@ -197,6 +197,9 @@ class GraphXfer:
                            layer_guid=src_node.layer_guid,
                            initializers=src_node.initializers)
                 n.weight_specs = list(src_node.weight_specs)
+                wsrc = getattr(src_node, "weight_source", None)
+                if wsrc:
+                    n.weight_source = wsrc  # tied weights survive rewrites
             else:
                 params = dx.make_params(m.ops) if dx.make_params else None
                 n = OpNode(dx.op_type, params)
@@ -270,6 +273,9 @@ def _clone_node(g: Graph, node: OpNode) -> OpNode:
                layer_guid=node.layer_guid, initializers=node.initializers)
     n.weight_specs = list(node.weight_specs)
     n.weight_axes = dict(node.weight_axes)
+    src = getattr(node, "weight_source", None)
+    if src:
+        n.weight_source = src  # tied weights survive rewrites by name
     if node.op_type == OT.OP_INPUT:
         # input nodes keep their ParallelTensor shape (degree-1 source)
         n.outputs = [ParallelTensor(pt.shape, name=pt.name)

@@ -100,3 +100,36 @@ def test_single_dataloader():
     np.testing.assert_array_equal(loader.next_batch(), data[:4])
     sharded = loader.next_batch_sharded()
     assert sharded.shape == (4, 16)
+
+
+def test_profiling_prints_per_op_table(capsys):
+    """--profiling produces the per-op forward/backward table
+    (linear_kernels.cu:95-117 analog)."""
+    import sys
+
+    import numpy as np
+
+    sys.argv = ["test", "--profiling"]
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+
+    config = FFConfig()
+    config.mesh_axis_sizes = (1, 1, 1, 1)
+    config.batch_size = 8
+    assert config.profiling
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 32))
+    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="prof_fc1")
+    t = ff.dense(t, 10, name="prof_head")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rs = np.random.RandomState(0)
+    ff.fit(rs.randn(16, 32).astype(np.float32),
+           rs.randint(0, 10, (16, 1)).astype(np.int32), epochs=1)
+    out = capsys.readouterr().out
+    assert "prof_fc1 [OP_LINEAR] forward time = " in out
+    assert "backward time = " in out
+    assert "TOTAL" in out
+    # printed once, not per epoch
+    ff.fit(rs.randn(16, 32).astype(np.float32),
+           rs.randint(0, 10, (16, 1)).astype(np.int32), epochs=1)
+    assert "prof_fc1" not in capsys.readouterr().out

@@ -257,10 +257,13 @@ def test_calibration_overrides_roofline():
     assert n_measured == 1
     assert _params_key(fc1) in cm._calibration
     after = cm.op_cost(fc1, [((),) * 2], {}, [(8, 64)], [((),) * 2])
-    measured = cm._calibration[_params_key(fc1)]
-    # forward now equals the measurement (full op, degree 1), not the
-    # roofline estimate
-    assert after.forward_time == pytest.approx(measured, rel=1e-6)
+    meas_fwd, meas_bwd = cm._calibration[_params_key(fc1)]
+    # forward and backward are DISTINCT measurements (the reference times
+    # both, linear.cc:792-925), not the 2x rule of thumb
+    assert meas_fwd > 0 and meas_bwd > 0
+    assert meas_bwd != pytest.approx(2.0 * meas_fwd, rel=1e-6)
+    assert after.forward_time == pytest.approx(meas_fwd, rel=1e-6)
+    assert after.backward_time == pytest.approx(meas_bwd, rel=1e-6)
     assert after.forward_time != pytest.approx(before.forward_time, rel=1e-3)
 
 

@@ -1,0 +1,136 @@
+"""Tied-weight tests: the builder's shared_op (reference dense/embedding
+shared_op, model.h) and Keras shared-layer semantics — one parameter set,
+gradients summed across uses."""
+
+import sys
+
+import numpy as np
+import pytest
+
+
+def _config(batch=16):
+    sys.argv = ["test"]
+    from flexflow_tpu import FFConfig
+
+    config = FFConfig()
+    config.mesh_axis_sizes = (1, 1, 1, 1)
+    config.batch_size = batch
+    return config
+
+
+def test_shared_dense_one_param_set_summed_grads():
+    from flexflow_tpu import ActiMode, FFModel, LossType, SGDOptimizer
+
+    config = _config(batch=8)
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 16))
+    t1 = ff.dense(x, 16, ActiMode.AC_MODE_RELU, name="tied")
+    out1 = t1
+    # second use reads the SAME parameters
+    t2 = ff.dense(out1, 16, ActiMode.AC_MODE_RELU, name="tied_again",
+                  shared_op=t1)
+    head = ff.dense(t2, 4, name="head")
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+
+    # only one parameter set exists
+    assert "tied" in ff._params
+    assert "tied_again" not in ff._params
+
+    w0 = ff.get_weight("tied", "kernel").copy()
+    assert np.array_equal(ff.get_weight("tied_again", "kernel"), w0)
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(16, 16).astype(np.float32)
+    ys = rs.randn(16, 4).astype(np.float32)
+    ff.fit(xs, ys, epochs=2)
+    w1 = ff.get_weight("tied", "kernel")
+    assert not np.array_equal(w1, w0), "tied weights must train"
+    # both names resolve to the same updated array
+    assert np.array_equal(ff.get_weight("tied_again", "kernel"), w1)
+
+
+def test_shared_grads_match_manual_tied_model():
+    """Numerics: a two-use tied dense must produce the same loss trajectory
+    as the same function expressed in raw jax with one weight used twice."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu import ActiMode, FFModel, LossType, SGDOptimizer
+
+    config = _config(batch=4)
+    ff = FFModel(config)
+    x = ff.create_tensor((4, 8))
+    t1 = ff.dense(x, 8, use_bias=False, name="w")
+    t2 = ff.dense(t1, 8, use_bias=False, name="w2", shared_op=t1)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(4, 8).astype(np.float32)
+    ys = rs.randn(4, 8).astype(np.float32)
+    w0 = ff.get_weight("w", "kernel").copy()
+
+    # reference implementation in raw jax: y = (x @ W) @ W, SGD(0.1)
+    def loss_fn(w):
+        y = (jnp.asarray(xs) @ w) @ w
+        return jnp.mean(jnp.sum((y - jnp.asarray(ys)) ** 2, axis=1))
+
+    w_ref = jnp.asarray(w0)
+    for _ in range(3):
+        g = jax.grad(loss_fn)(w_ref)
+        w_ref = w_ref - 0.1 * g
+
+    ff.fit(xs, ys, epochs=3, shuffle=False)
+    np.testing.assert_allclose(ff.get_weight("w", "kernel"),
+                               np.asarray(w_ref), rtol=2e-4, atol=2e-5)
+
+
+def test_shared_op_type_mismatch_raises():
+    from flexflow_tpu import FFModel
+
+    config = _config()
+    ff = FFModel(config)
+    x = ff.create_tensor((16, 8))
+    t = ff.relu(ff.dense(x, 8, name="a"), name="r")
+    with pytest.raises(ValueError, match="shared_op"):
+        ff.dense(t, 8, shared_op=t)  # t is the relu output
+
+
+def test_shared_embedding():
+    """Tied input/output embeddings (the LM weight-tying pattern)."""
+    from flexflow_tpu import FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.fftype import DataType
+
+    config = _config(batch=8)
+    ff = FFModel(config)
+    toks = ff.create_tensor((8, 4), DataType.DT_INT32, name="toks")
+    e1 = ff.embedding(toks, 32, 16, name="emb")
+    toks2 = ff.create_tensor((8, 4), DataType.DT_INT32, name="toks2")
+    e2 = ff.embedding(toks2, 32, 16, name="emb2", shared_op=e1)
+    t = ff.add(e1, e2)
+    t = ff.dense(t, 8, name="head")
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)
+    assert "emb2" not in ff._params
+    assert np.array_equal(ff.get_weight("emb2", "kernel"),
+                          ff.get_weight("emb", "kernel"))
+
+
+def test_keras_shared_layer_shares_weights():
+    """A Keras layer called twice references one parameter set (was a
+    documented NOTE/gap: per-call parameter copies)."""
+    from flexflow_tpu.keras import Dense, Input, Model
+
+    inp = Input(shape=(12,), batch_size=8)
+    shared = Dense(12, activation="relu", name="shared_fc")
+    h1 = shared(inp)
+    h2 = shared(h1)  # second call: same weights
+    out = Dense(4, name="head")(h2)
+    m = Model(inputs=inp, outputs=out)
+    m.ffconfig.batch_size = 8
+    ff = m.compile(optimizer="sgd", loss="mse")
+    assert "shared_fc" in ff._params
+    assert "shared_fc_call1" not in ff._params
+    assert np.array_equal(ff.get_weight("shared_fc_call1", "kernel"),
+                          ff.get_weight("shared_fc", "kernel"))
