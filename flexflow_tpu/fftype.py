@@ -147,6 +147,9 @@ class OperatorType(enum.IntEnum):
     OP_AGG_SPEC = enum.auto()
     # TPU-native addition: stacked-experts op enabling expert-axis sharding
     OP_EXPERTS = enum.auto()
+    # TPU-native addition: stacked transformer blocks runnable as a
+    # ppermute pipeline over the `pipe` mesh axis (parallel/pipeline.py)
+    OP_PIPE_BLOCKS = enum.auto()
     OP_RESHAPE = enum.auto()
     OP_REVERSE = enum.auto()
     OP_TRANSPOSE = enum.auto()

@@ -50,7 +50,7 @@ from .fftype import (
 from .initializer import Initializer
 from .layer import Layer
 from .loss import loss_value
-from .machine import AXIS_DATA, AXIS_MODEL, MachineView, build_mesh
+from .machine import AXIS_DATA, AXIS_MODEL, AXIS_PIPE, MachineView, build_mesh
 from .metrics import Metrics, PerfMetrics
 from .optimizer import Optimizer, SGDOptimizer
 from .ops import (
@@ -535,6 +535,28 @@ class FFModel:
         agg_inputs = [topk_values, topk_assign, topk_assign, gate_probs] + expert_outputs
         return self.aggregate(agg_inputs, num_exp, lambda_bal)
 
+    def pipeline_blocks(
+        self,
+        input: Tensor,
+        num_layers: int,
+        num_heads: int,
+        mlp_ratio: int = 4,
+        num_microbatches: int = 0,
+        causal: bool = True,
+        attention_impl: str = "xla",
+        name: str = "",
+    ) -> Tensor:
+        """L stacked pre-LN transformer blocks as one op whose layer dim
+        shards over the `pipe` mesh axis — working pipeline parallelism
+        (ppermute fill/drain schedule, parallel/pipeline.py), exceeding the
+        reference's enum-only OP_PIPELINE (ffconst.h:159)."""
+        from .ops import PipelineBlocksParams
+
+        p = PipelineBlocksParams(num_layers, num_heads, mlp_ratio,
+                                 num_microbatches, causal, attention_impl)
+        return self._add_layer(OT.OP_PIPE_BLOCKS, p, [input], name,
+                               data_type=input.dtype).outputs[0]
+
     # ------------------------------------------------ parallel ops
     # (reference src/parallel_ops/*; inserted explicitly or by Unity search)
 
@@ -818,6 +840,17 @@ class FFModel:
                         # multi-host meshes compose (dcn, data) on the batch
                         assignment[0] = batch_axes
                     pt.assign_axes(tuple(assignment))
+            if (node.op_type == OT.OP_PIPE_BLOCKS
+                    and self.mesh.shape.get(AXIS_PIPE, 1) > 1):
+                # default pipe-axis sharding of the stacked block weights:
+                # each stage stores only its layers (+ optimizer slots),
+                # and the shard_map schedule consumes exactly this layout —
+                # no per-step weight collectives
+                for ws in node.weight_specs:
+                    node.weight_axes.setdefault(
+                        ws.name,
+                        PartitionSpec(AXIS_PIPE, *([None] * (len(ws.shape) - 1))),
+                    )
             for i, spec_axes in ov.get("outputs", {}).items():
                 node.outputs[i].assign_axes(spec_axes)
             node.weight_axes.update(ov.get("weights", {}))

@@ -16,5 +16,6 @@ from .transformer import (
     TransformerLMConfig,
     build_transformer,
     build_transformer_lm,
+    build_transformer_lm_pipelined,
 )
 from .xdl import build_xdl

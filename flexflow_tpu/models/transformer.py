@@ -108,6 +108,31 @@ def build_transformer_lm(ff, config: TransformerLMConfig | None = None,
     return tokens, logits
 
 
+def build_transformer_lm_pipelined(ff, config: TransformerLMConfig | None = None,
+                                   batch_size: int | None = None,
+                                   num_microbatches: int = 0):
+    """The flagship LM with its block stack as ONE PipelineBlocks op: the
+    layer dim shards over the `pipe` mesh axis (ppermute fill/drain
+    pipeline, parallel/pipeline.py) — pipeline-parallel capability the
+    reference's enum-only OP_PIPELINE never implements. Identical numerics
+    to a sequential block stack by construction (same op, pipe axis 1)."""
+    c = config or TransformerLMConfig()
+    bs = batch_size or ff.config.batch_size
+    tokens = ff.create_tensor((bs, c.sequence_length), DataType.DT_INT32,
+                              name="tokens")
+    h = ff.embedding(tokens, c.vocab_size, c.hidden_size, name="wte")
+    pos = ff.create_tensor((bs, c.sequence_length), DataType.DT_INT32,
+                           name="positions")
+    hp = ff.embedding(pos, c.sequence_length, c.hidden_size, name="wpe")
+    h = ff.add(h, hp, name="embed_add")
+    h = ff.pipeline_blocks(h, c.num_layers, c.num_heads, c.mlp_ratio,
+                           num_microbatches=num_microbatches, causal=True,
+                           attention_impl=c.attention_impl, name="blocks")
+    h = ff.layer_norm(h, [2], name="ln_f")
+    logits = ff.dense(h, c.vocab_size, use_bias=False, name="lm_head")
+    return tokens, logits
+
+
 def transformer_lm_flops_per_token(c: TransformerLMConfig) -> float:
     """Analytic fwd+bwd FLOPs/token for MFU accounting (6N_matmul + attn).
     The wte/wpe lookups are gathers (no matmul FLOPs); only the lm_head's

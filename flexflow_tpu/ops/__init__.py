@@ -6,6 +6,7 @@ from . import core  # noqa: F401
 from . import shape_ops  # noqa: F401
 from . import attention  # noqa: F401
 from . import moe  # noqa: F401
+from . import pipeline_blocks  # noqa: F401
 
 from .core import (
     BatchMatmulParams,
@@ -27,6 +28,7 @@ from .moe import (
     ExpertsParams,
     GroupByParams,
 )
+from .pipeline_blocks import PipelineBlocksParams
 from .shape_ops import (
     CastParams,
     ConcatParams,

@@ -1,0 +1,126 @@
+"""PipelineBlocks: L stacked pre-LN transformer blocks as ONE op.
+
+TPU-native design (no analog in the reference, whose OP_PIPELINE is an
+unimplemented enum — ffconst.h:159): stacking the repeated blocks' weights
+on a leading layer dim makes pipeline parallelism a plain sharding of that
+dim over the `pipe` mesh axis; the op's forward then runs the ppermute
+fill/drain schedule of parallel/pipeline.py when the mesh has a pipe axis,
+and the identical sequential scan otherwise — so a pipelined model shares
+numerics with its single-chip build by construction. Each block is wrapped
+in jax.checkpoint so in-flight microbatches hold O(1) activations per
+stage (the memory property 1F1B-style schedules exist for)."""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..fftype import DataType, OperatorType as OT
+from .base import OpDef, WeightSpec, register_op
+
+
+@dataclass(frozen=True)
+class PipelineBlocksParams:
+    num_layers: int
+    num_heads: int
+    mlp_ratio: int = 4
+    num_microbatches: int = 0  # 0 → 2 · pipe-axis size
+    causal: bool = True
+    attention_impl: str = "xla"  # xla | flash (ring needs the seq axis)
+
+
+def _pb_infer(p: PipelineBlocksParams, in_shapes):
+    return [in_shapes[0]]
+
+
+def _pb_weights(p: PipelineBlocksParams, in_shapes):
+    d = in_shapes[0][-1]
+    h = p.mlp_ratio * d
+    L = p.num_layers
+    F = DataType.DT_FLOAT
+    return [
+        WeightSpec("ln1_scale", (L, d), F, "ones"),
+        WeightSpec("ln1_bias", (L, d), F, "zeros"),
+        WeightSpec("wqkv", (L, d, 3 * d), F),
+        WeightSpec("wo", (L, d, d), F),
+        WeightSpec("ln2_scale", (L, d), F, "ones"),
+        WeightSpec("ln2_bias", (L, d), F, "zeros"),
+        WeightSpec("w1", (L, d, h), F),
+        WeightSpec("b1", (L, h), F, "zeros"),
+        WeightSpec("w2", (L, h, d), F),
+        WeightSpec("b2", (L, d), F, "zeros"),
+    ]
+
+
+def _ln(x, scale, bias):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + 1e-5)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _make_block_fn(num_heads: int, causal: bool, attention_impl: str):
+    if attention_impl == "flash":
+        from ..kernels.flash_attention import flash_attention as _attn
+    elif attention_impl == "xla":
+        from .attention import sdpa_xla as _attn
+    else:
+        raise ValueError(
+            f"PipelineBlocks supports attention_impl 'xla' or 'flash', "
+            f"got {attention_impl!r} (ring attention needs the seq axis, "
+            f"which the pipe schedule does not thread)")
+
+    def block(w, x):  # w: one layer's weights; x: (mb, s, d)
+        d = x.shape[-1]
+        hd = d // num_heads
+
+        a = _ln(x, w["ln1_scale"], w["ln1_bias"])
+        qkv = a @ w["wqkv"].astype(a.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            b, s, _ = t.shape
+            return t.reshape(b, s, num_heads, hd).transpose(0, 2, 1, 3)
+
+        o = _attn(heads(q), heads(k), heads(v), causal=causal,
+                  scale=1.0 / math.sqrt(hd))
+        b, _, s, _ = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+        x = x + o @ w["wo"].astype(o.dtype)
+
+        m = _ln(x, w["ln2_scale"], w["ln2_bias"])
+        m = jax.nn.gelu(m @ w["w1"].astype(m.dtype)
+                        + w["b1"].astype(m.dtype))
+        m = m @ w["w2"].astype(m.dtype) + w["b2"].astype(m.dtype)
+        return x + m
+
+    # O(1) activations per in-flight microbatch: recompute inside bwd
+    return jax.checkpoint(block)
+
+
+def _pb_forward(p: PipelineBlocksParams, inputs, weights, state, ctx):
+    from ..parallel.pipeline import pipeline_apply
+
+    (x,) = inputs
+    out = pipeline_apply(
+        weights, x,
+        _make_block_fn(p.num_heads, p.causal, p.attention_impl),
+        mesh=ctx.mesh, num_microbatches=p.num_microbatches,
+    )
+    return [out], state
+
+
+def _pb_flops(p: PipelineBlocksParams, in_shapes, out_shapes):
+    b, s, d = in_shapes[0]
+    per_layer = 2.0 * b * s * (4 * d * d + 2 * p.mlp_ratio * d * d)
+    attn = 4.0 * b * p.num_heads * s * s * (d // p.num_heads)
+    return p.num_layers * (per_layer + attn)
+
+
+register_op(
+    OpDef(OT.OP_PIPE_BLOCKS, _pb_infer, _pb_forward, _pb_weights, _pb_flops)
+)

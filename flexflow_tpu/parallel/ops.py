@@ -23,9 +23,12 @@ rewrites operate on, and the cost model charges the communication bytes these
 transforms imply (see search/cost_model.py).
 
 The reference leaves OP_PIPELINE as an enum with no implementation
-(ffconst.h:159, SURVEY §2.3); here PipelineParams marks a stage boundary that
-the executor may schedule with `jax.lax.ppermute`-based 1F1B (exceeding
-reference capability when enabled).
+(ffconst.h:159, SURVEY §2.3); here PipelineParams is likewise a stage
+MARKER only (runtime identity — enum parity). Working pipeline parallelism
+lives in the OP_PIPE_BLOCKS op instead: stacked homogeneous blocks whose
+layer dim shards over the `pipe` mesh axis, scheduled as a
+`jax.lax.ppermute` fill/drain microbatch pipeline (parallel/pipeline.py) —
+the capability the reference never implemented.
 """
 
 from __future__ import annotations

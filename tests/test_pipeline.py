@@ -1,0 +1,147 @@
+"""Pipeline-parallelism tests: the ppermute fill/drain schedule
+(parallel/pipeline.py + the OP_PIPE_BLOCKS op) must match the sequential
+stack exactly — forward AND gradients — and train end-to-end on a
+(data × pipe) mesh. The reference's OP_PIPELINE is an unimplemented enum
+(ffconst.h:159); these tests certify the capability that exceeds it."""
+
+import sys
+
+import numpy as np
+import pytest
+
+
+def _config(mesh_axes, batch=8):
+    sys.argv = ["test"]
+    from flexflow_tpu import FFConfig
+
+    config = FFConfig()
+    config.mesh_axis_sizes = mesh_axes
+    config.batch_size = batch
+    return config
+
+
+def test_pipeline_apply_matches_sequential():
+    """Raw schedule check: pipelined forward and grads == sequential scan."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.machine import build_mesh, MeshShape
+    from flexflow_tpu.parallel.pipeline import pipeline_apply, _sequential
+
+    rs = np.random.RandomState(0)
+    L, b, d = 4, 8, 16
+    stacked = {
+        "w": jnp.asarray(rs.randn(L, d, d) * 0.1, jnp.float32),
+        "b": jnp.asarray(rs.randn(L, d) * 0.1, jnp.float32),
+    }
+    x = jnp.asarray(rs.randn(b, d), jnp.float32)
+
+    def block(w, a):
+        return jnp.tanh(a @ w["w"] + w["b"])
+
+    mesh = build_mesh(MeshShape((2, 1, 4, 1)))  # data=2, pipe=4
+
+    def loss_seq(s, x):
+        return jnp.sum(_sequential(s, x, block) ** 2)
+
+    def loss_pipe(s, x):
+        return jnp.sum(pipeline_apply(
+            s, x, block, mesh=mesh, num_microbatches=4) ** 2)
+
+    with mesh:
+        y_seq = jax.jit(lambda s, x: _sequential(s, x, block))(stacked, x)
+        y_pipe = jax.jit(lambda s, x: pipeline_apply(
+            s, x, block, mesh=mesh, num_microbatches=4))(stacked, x)
+        np.testing.assert_allclose(np.asarray(y_pipe), np.asarray(y_seq),
+                                   rtol=1e-6, atol=1e-6)
+        g_seq = jax.jit(jax.grad(loss_seq))(stacked, x)
+        g_pipe = jax.jit(jax.grad(loss_pipe))(stacked, x)
+        for k in stacked:
+            np.testing.assert_allclose(np.asarray(g_pipe[k]),
+                                       np.asarray(g_seq[k]),
+                                       rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_rejects_indivisible_layers():
+    import jax.numpy as jnp
+
+    from flexflow_tpu.machine import build_mesh, MeshShape
+    from flexflow_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = build_mesh(MeshShape((1, 1, 4, 1)))
+    stacked = {"w": jnp.zeros((3, 4, 4))}  # 3 layers, 4 stages
+    with pytest.raises(ValueError, match="pipeline"):
+        pipeline_apply(stacked, jnp.zeros((4, 4)), lambda w, a: a,
+                       mesh=mesh)
+
+
+def _logits_of(mesh_axes, batch=4):
+    import jax
+
+    from flexflow_tpu import FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import (
+        TransformerLMConfig, build_transformer_lm_pipelined,
+    )
+
+    config = _config(mesh_axes, batch=batch)
+    ff = FFModel(config)
+    c = TransformerLMConfig(vocab_size=64, hidden_size=32, num_heads=2,
+                            num_layers=4, sequence_length=16,
+                            attention_impl="xla")
+    build_transformer_lm_pipelined(ff, c, batch_size=batch,
+                                   num_microbatches=2)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, c.vocab_size, (batch, 16)).astype(np.int32)
+    pos = np.tile(np.arange(16, dtype=np.int32), (batch, 1))
+    fwd = ff.executor.build_forward()
+    xs = ff.executor.shard_batch(
+        {"tokens": toks, "positions": pos},
+        {n.name: n.outputs[0].partition_spec()
+         for n in ff.graph.sources()})
+    logits, _ = fwd(ff._params, ff._state, xs, False)
+    return np.asarray(jax.device_get(logits)), ff, c, toks, pos
+
+
+def test_two_stage_lm_matches_single_device():
+    """The pp=2 LM's logits equal the same model on a 1-device mesh (same
+    seeds → same init → same function)."""
+    single, *_ = _logits_of((1, 1, 1, 1))
+    piped, *_ = _logits_of((2, 1, 2, 1))  # data=2 × pipe=2
+    np.testing.assert_allclose(piped, single, rtol=2e-5, atol=2e-5)
+
+
+def test_pipelined_lm_trains():
+    """End-to-end fit on the (data × pipe) mesh: loss decreases."""
+    from flexflow_tpu import FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import (
+        TransformerLMConfig, build_transformer_lm_pipelined,
+    )
+
+    batch = 8
+    config = _config((2, 1, 2, 1), batch=batch)
+    ff = FFModel(config)
+    c = TransformerLMConfig(vocab_size=64, hidden_size=32, num_heads=2,
+                            num_layers=4, sequence_length=16,
+                            attention_impl="xla")
+    build_transformer_lm_pipelined(ff, c, batch_size=batch,
+                                   num_microbatches=2)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, c.vocab_size, (batch, 16)).astype(np.int32)
+    pos = np.tile(np.arange(16, dtype=np.int32), (batch, 1))
+    labels = rs.randint(0, c.vocab_size, (batch, 16, 1)).astype(np.int32)
+    bd = ff._make_batch({"tokens": toks, "positions": pos}, labels)
+    step = ff.executor.build_train_step()
+    import jax
+
+    state = (ff._params, ff._state, ff._opt_slots, ff._step, ff._counters)
+    losses = []
+    for i in range(8):
+        out = step(*state, jax.random.key(i), bd)
+        state = out[:5]
+        losses.append(float(out[-1]))
+    assert losses[-1] < losses[0], losses
