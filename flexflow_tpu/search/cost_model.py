@@ -273,10 +273,14 @@ class CostModel:
     """Costs one node / one whole strategy; memoized like the reference's
     (params, view) cache (simulator.h strict/relaxed hash caches)."""
 
-    def __init__(self, machine: TPUMachineModel, mfu: float = 0.4):
+    def __init__(self, machine: TPUMachineModel, mfu: float = 0.4,
+                 opt_slots: int = 1):
         self.machine = machine
         # achievable fraction of peak (calibration refines per-op)
         self.mfu = mfu
+        # optimizer state entries per weight (SGD momentum 1, Adam 2) for
+        # the memory model
+        self.opt_slots = opt_slots
         self._cache: dict = {}
         self._calibration: dict = {}
 
@@ -367,14 +371,20 @@ class CostModel:
             # rule of thumb (also the reference simulator's default) when
             # unmeasured: bwd ≈ 2× fwd
             bwd = 2.0 * fwd
+        # per-chip memory (MemoryUsage analog, memory_optimization.h:44-105):
+        # master weight + gradient + optimizer slots (opt_slots: 1 for SGD
+        # momentum, 2 for Adam) + every output activation at its dtype
+        act_bytes = 0.0
+        for i, pt in enumerate(node.outputs):
+            a = out_assigns[i] if out_assigns and i < len(out_assigns) else ()
+            act_bytes += _shard_elems(
+                tuple(d.size for d in pt.shape.dims if not d.is_replica_dim),
+                a, axis_sizes) * dtype_bytes(pt.dtype)
         cm = CostMetrics(
             forward_time=fwd,
             backward_time=bwd,
             sync_time=sync,
-            memory=weight_bytes * 3  # weight + grad + optimizer slot
-            + _shard_elems(out_shapes[0] if out_shapes else (),
-                           out_assigns[0] if out_assigns else (),
-                           axis_sizes) * 4,
+            memory=weight_bytes * (2 + self.opt_slots) + act_bytes,
         )
         self._cache[key] = cm
         return cm

@@ -586,6 +586,64 @@ _FEATURE_ELEMENTWISE = frozenset({
 })
 
 
+def mcmc_optimize(search: UnitySearch, budget: int = 1000,
+                  alpha: float = 0.05, seed: int = 0) -> dict:
+    """Legacy pre-Unity MCMC strategy search (FFModel::mcmc_optimize,
+    model.cc:3285-3357, exposed via STRATEGY_SEARCH_TASK_ID): simulated
+    annealing over per-node configs starting from data parallel — a random
+    single-node rewrite per iteration, accepted when cheaper or with
+    probability exp(-alpha·Δ), with a periodic reset to the incumbent
+    (reset_span = clamp(budget/100, 1, 1000)). Returns {guid -> NodeConfig};
+    superseded by the joint Unity search but kept for parity."""
+    import random
+
+    rng = random.Random(seed)
+    mutable = [n for n in search.order if len(search.node_configs(n)) > 1]
+
+    def cost_of(choice):
+        t, mem = search.evaluate(choice)
+        return search._memory_penalized(t, mem)
+
+    best = {n.guid: search.node_configs(n)[0] for n in search.order
+            if search.node_configs(n)}
+    best_cost = cost_of(best)
+    current, current_cost = dict(best), best_cost
+    if not mutable:
+        return best
+    reset_span = min(max(budget // 100, 1), 1000)
+    last_reset = 0
+    for it in range(budget + 1):
+        if it - last_reset >= reset_span:
+            current, current_cost = dict(best), best_cost
+            last_reset = it
+        node = rng.choice(mutable)
+        cfgs = search.node_configs(node)
+        nxt = dict(current)
+        nxt[node.guid] = rng.choice(cfgs)
+        nxt_cost = cost_of(nxt)
+        if nxt_cost < best_cost:
+            best, best_cost = dict(nxt), nxt_cost
+        if nxt_cost < current_cost or (
+                rng.random() < math.exp(
+                    -alpha * max(0.0, nxt_cost - current_cost) * 1e6)):
+            current, current_cost = nxt, nxt_cost
+    return best
+
+
+def mcmc_search_strategy(graph, mesh, config,
+                         cost_model: Optional[CostModel] = None) -> Strategy:
+    """MCMC entry returning a Strategy (the STRATEGY_SEARCH_TASK_ID
+    surface)."""
+    from .machine_model import machine_model_for_mesh
+
+    cm = cost_model or CostModel(machine_model_for_mesh(mesh))
+    search = UnitySearch(graph, mesh, config, cm)
+    budget = config.search_budget or 1000
+    choice = mcmc_optimize(search, budget=budget,
+                           alpha=config.search_alpha, seed=config.seed)
+    return search.to_strategy(choice)
+
+
 def lambda_memory_search(make_search, hbm_bytes: float, iters: int = 5):
     """λ binary search between pure-runtime and memory-lean strategies
     (graph_optimize_task, graph.cc:2056-2131). `make_search()` supplies the

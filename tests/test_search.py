@@ -375,3 +375,40 @@ def test_axis_contention_serializes_same_axis_comm():
                               axis=[-1, 0, 1, -1]) == pytest.approx(diff)
     finally:
         native._lib, native._lib_tried = saved, saved_t
+
+
+def test_mcmc_legacy_search_never_worse_than_dp():
+    """The legacy MCMC strategy search (model.cc:3285-3357 parity) finds a
+    strategy at least as good as pure data parallel under the same
+    evaluator."""
+    sys.argv = ["test", "--budget", "200"]
+    from flexflow_tpu import ActiMode, FFConfig, FFModel
+    from flexflow_tpu.machine import build_mesh
+    from flexflow_tpu.search import CostModel, machine_model_for_mesh
+    from flexflow_tpu.search.unity import UnitySearch, mcmc_optimize
+    from tests.test_joint_search import _pcg_of
+
+    config = FFConfig()
+    config.mesh_axis_sizes = (2, 4, 1, 1)
+    config.batch_size = 16
+    ff = FFModel(config)
+    x = ff.create_tensor((16, 256))
+    t = x
+    for i in range(3):
+        t = ff.dense(t, 2048, ActiMode.AC_MODE_RELU, name=f"mc{i}")
+    ff.dense(t, 16, name="mc_head")
+    g = _pcg_of(ff)
+    mesh = build_mesh(config.mesh_shape())
+    cm = CostModel(machine_model_for_mesh(mesh))
+    s = UnitySearch(g, mesh, config, cm)
+
+    dp = {n.guid: s.node_configs(n)[0] for n in s.order if s.node_configs(n)}
+    t_dp, m_dp = s.evaluate(dp)
+    dp_cost = s._memory_penalized(t_dp, m_dp)
+
+    best = mcmc_optimize(s, budget=200, alpha=config.search_alpha)
+    t_b, m_b = s.evaluate(best)
+    best_cost = s._memory_penalized(t_b, m_b)
+    assert best_cost <= dp_cost * 1.0001
+    # on this TP-friendly MLP the annealer should actually move off DP
+    assert any(cfg.name != "dp" for cfg in best.values())
