@@ -714,7 +714,8 @@ class FFModel:
                 else machine_model_for_mesh(
                     self.mesh, num_hosts=self.config.num_nodes)
             )
-            cost_model = CostModel(machine)
+            cost_model = CostModel(
+                machine, opt_slots=self.optimizer.num_slots)
 
             def _calibrate():
                 # measure the dominant ops on the local chip so the search

@@ -25,6 +25,12 @@ class Optimizer:
     """Pytree-functional optimizer. `init(params)` → slots, `update(grads,
     params, slots, step)` → (new_params, new_slots)."""
 
+    @property
+    def num_slots(self) -> int:
+        """Optimizer state entries per weight (for the search's memory
+        model): SGD momentum 1 (0 without momentum), Adam 2."""
+        return 1
+
     def init(self, params):
         raise NotImplementedError
 
@@ -42,6 +48,10 @@ class SGDOptimizer(Optimizer):
     momentum: float = 0.0
     nesterov: bool = False
     weight_decay: float = 0.0
+
+    @property
+    def num_slots(self) -> int:
+        return 1 if self.momentum > 0.0 else 0
 
     def init(self, params):
         if self.momentum == 0.0:
@@ -69,6 +79,10 @@ class AdamOptimizer(Optimizer):
     beta2: float = 0.999
     weight_decay: float = 0.0
     epsilon: float = 1e-8
+
+    @property
+    def num_slots(self) -> int:
+        return 2  # m and v
 
     def init(self, params):
         return {

@@ -19,4 +19,4 @@ simulator (graph.cc, substitution.cc, simulator.cc). The TPU-native recast:
 
 from .cost_model import CostMetrics, CostModel, classify_reshard
 from .machine_model import TPUMachineModel, machine_model_for_mesh
-from .unity import UnitySearch, search_strategy
+from .unity import UnitySearch, mcmc_search_strategy, search_strategy

@@ -322,15 +322,18 @@ class CostModel:
             degree *= axis_sizes.get(ax, 1)
         shard_flops = full_flops / max(1, degree)
 
-        # bytes touched: inputs + outputs + weights per chip
+        # bytes touched: inputs + outputs + weights per chip (the output
+        # bytes double as the activation-memory term below)
         bytes_touched = 0.0
         for shape, assign in zip(in_shapes, in_assigns):
             bytes_touched += _shard_elems(shape, assign, axis_sizes) * 4
+        act_bytes = 0.0
         for i, pt in enumerate(node.outputs):
             a = out_assigns[i] if out_assigns and i < len(out_assigns) else ()
-            bytes_touched += _shard_elems(
+            act_bytes += _shard_elems(
                 tuple(d.size for d in pt.shape.dims if not d.is_replica_dim),
                 a, axis_sizes) * dtype_bytes(pt.dtype)
+        bytes_touched += act_bytes
 
         # tied-weight nodes (shared_op) read another node's parameters: the
         # bytes are still touched each step, but the weight/grad/optimizer
@@ -374,12 +377,6 @@ class CostModel:
         # per-chip memory (MemoryUsage analog, memory_optimization.h:44-105):
         # master weight + gradient + optimizer slots (opt_slots: 1 for SGD
         # momentum, 2 for Adam) + every output activation at its dtype
-        act_bytes = 0.0
-        for i, pt in enumerate(node.outputs):
-            a = out_assigns[i] if out_assigns and i < len(out_assigns) else ()
-            act_bytes += _shard_elems(
-                tuple(d.size for d in pt.shape.dims if not d.is_replica_dim),
-                a, axis_sizes) * dtype_bytes(pt.dtype)
         cm = CostMetrics(
             forward_time=fwd,
             backward_time=bwd,

@@ -592,9 +592,11 @@ def mcmc_optimize(search: UnitySearch, budget: int = 1000,
     model.cc:3285-3357, exposed via STRATEGY_SEARCH_TASK_ID): simulated
     annealing over per-node configs starting from data parallel — a random
     single-node rewrite per iteration, accepted when cheaper or with
-    probability exp(-alpha·Δ), with a periodic reset to the incumbent
-    (reset_span = clamp(budget/100, 1, 1000)). Returns {guid -> NodeConfig};
-    superseded by the joint Unity search but kept for parity."""
+    probability exp(-alpha·Δµs) (Δ is in seconds here where the reference
+    simulator works in ~µs-scale units, hence the 1e6 factor below), with a
+    periodic reset to the incumbent (reset_span = clamp(budget/100, 1,
+    1000)). Returns {guid -> NodeConfig}; superseded by the joint Unity
+    search but kept for parity."""
     import random
 
     rng = random.Random(seed)
@@ -631,16 +633,19 @@ def mcmc_optimize(search: UnitySearch, budget: int = 1000,
 
 
 def mcmc_search_strategy(graph, mesh, config,
-                         cost_model: Optional[CostModel] = None) -> Strategy:
+                         cost_model: Optional[CostModel] = None,
+                         alpha: float = 0.05) -> Strategy:
     """MCMC entry returning a Strategy (the STRATEGY_SEARCH_TASK_ID
-    surface)."""
+    surface). `alpha` is the annealing temperature coefficient (reference
+    default 0.05) — deliberately NOT config.search_alpha, which is the
+    best-first pruning slack with a completely different scale."""
     from .machine_model import machine_model_for_mesh
 
     cm = cost_model or CostModel(machine_model_for_mesh(mesh))
     search = UnitySearch(graph, mesh, config, cm)
     budget = config.search_budget or 1000
-    choice = mcmc_optimize(search, budget=budget,
-                           alpha=config.search_alpha, seed=config.seed)
+    choice = mcmc_optimize(search, budget=budget, alpha=alpha,
+                           seed=config.seed)
     return search.to_strategy(choice)
 
 

@@ -406,9 +406,15 @@ def test_mcmc_legacy_search_never_worse_than_dp():
     t_dp, m_dp = s.evaluate(dp)
     dp_cost = s._memory_penalized(t_dp, m_dp)
 
-    best = mcmc_optimize(s, budget=200, alpha=config.search_alpha)
+    best = mcmc_optimize(s, budget=200)
     t_b, m_b = s.evaluate(best)
     best_cost = s._memory_penalized(t_b, m_b)
     assert best_cost <= dp_cost * 1.0001
     # on this TP-friendly MLP the annealer should actually move off DP
     assert any(cfg.name != "dp" for cfg in best.values())
+
+    # the Strategy-returning entry point works end to end too
+    from flexflow_tpu.search import mcmc_search_strategy
+
+    strat = mcmc_search_strategy(g, mesh, config, cost_model=cm)
+    assert strat.overrides, "MCMC strategy should move off DP here"
