@@ -66,6 +66,8 @@ def run(only_dp: bool, export_to: str = "", import_from: str = ""):
 
 
 if __name__ == "__main__":
+    import json
+
     plan = os.path.join(tempfile.gettempdir(), "unity_plan.json")
     dp = run(only_dp=True)
     unity = run(only_dp=False, export_to=plan)
@@ -75,3 +77,14 @@ if __name__ == "__main__":
     print(f"Unity (replay): {replay:.1f} samples/s  (imported {plan}, "
           f"no re-search)")
     print(f"speedup:  {unity / dp:.2f}x")
+    # machine-readable artifact (the AE scripts' measured-result analog)
+    artifact = os.environ.get("UNITY_VS_DP_ARTIFACT", "unity_vs_dp.json")
+    with open(artifact, "w") as f:
+        json.dump({
+            "dp_samples_per_s": round(dp, 2),
+            "unity_samples_per_s": round(unity, 2),
+            "unity_replay_samples_per_s": round(replay, 2),
+            "speedup": round(unity / dp, 3),
+            "plan_file": plan,
+        }, f, indent=1)
+    print(f"wrote {artifact}")

@@ -84,6 +84,7 @@ class UnitySearch:
         self.axis_sizes = dict(mesh.shape)
         self.model_deg = self.axis_sizes.get(AXIS_MODEL, 1)
         self.data_deg = self.axis_sizes.get(AXIS_DATA, 1)
+        self.seq_deg = self.axis_sizes.get(AXIS_SEQ, 1)
         # multi-host meshes compose (dcn, data) on the batch dim; DCN-axis
         # collectives are priced at DCN bandwidth by the machine model
         self.batch_axes = batch_axes_for(self.axis_sizes)
@@ -120,12 +121,20 @@ class UnitySearch:
         dp = NodeConfig("dp", _dp_assign(ndim, batch_ok,
                                           batch_axes=self.batch_axes))
         out = [dp]
-        if self.config.only_data_parallel or self.model_deg <= 1:
+        if self.config.only_data_parallel or (
+                self.model_deg <= 1 and self.seq_deg <= 1):
             return out
-        allow_param = (self.config.enable_parameter_parallel
-                       or self.config.search_budget > 0)
-        allow_attr = (self.config.enable_attribute_parallel
-                      or self.config.search_budget > 0)
+        allow_param = (self.model_deg > 1
+                       and (self.config.enable_parameter_parallel
+                            or self.config.search_budget > 0))
+        allow_attr = (self.model_deg > 1
+                      and (self.config.enable_attribute_parallel
+                           or self.config.search_budget > 0))
+        # seq/sample-dim families gate on the reference's sample-parallel
+        # flag (config.h:134), like param/attr families gate on theirs
+        allow_seq = (self.seq_deg > 1
+                     and (self.config.enable_sample_parallel
+                          or self.config.search_budget > 0))
         if node.op_type == OT.OP_LINEAR and allow_param:
             p = node.params
             if p.out_channels % self.model_deg == 0:
@@ -143,9 +152,9 @@ class UnitySearch:
                  ("bias", PartitionSpec())),
                 psum_axes=(AXIS_MODEL,),
             ))
-        elif node.op_type == OT.OP_MULTIHEAD_ATTENTION and allow_attr:
+        elif node.op_type == OT.OP_MULTIHEAD_ATTENTION:
             p = node.params
-            if p.num_heads % self.model_deg == 0:
+            if allow_attr and p.num_heads % self.model_deg == 0:
                 ws = [(w, PartitionSpec(None, AXIS_MODEL))
                       for w in ("wq", "wk", "wv")]
                 ws += [(b, PartitionSpec(AXIS_MODEL))
@@ -158,6 +167,19 @@ class UnitySearch:
                     tuple(ws),
                     psum_axes=(AXIS_MODEL,),
                 ))
+            if (getattr(p, "impl", "") == "ring" and ndim == 3
+                    and allow_seq
+                    and node.outputs[0].shape.dims[1].size
+                    % self.seq_deg == 0):
+                # sequence-parallel config (AXIS_SEQ): ring attention keeps
+                # queries resident while K/V rotate over the seq axis, so
+                # the (b, s, d) activation stays seq-sharded through the op
+                # — the long-context capability the reference lacks
+                # (SURVEY §5); paired with the "sp" pass-through below
+                assign = list(_dp_assign(ndim, batch_ok,
+                                         batch_axes=self.batch_axes))
+                assign[1] = (AXIS_SEQ,)
+                out.append(NodeConfig("sp", tuple(assign)))
         elif node.op_type == OT.OP_CONV2D and allow_attr and ndim == 4:
             # channel/attribute-parallel conv (NCHW dim 1 over `model`,
             # OIHW kernel dim 0 sharded) — the conv sibling of tp_attn
@@ -193,7 +215,7 @@ class UnitySearch:
             # channel passthrough so a tp_conv chain can stay sharded on
             # NCHW dim 1 between conv pairs
             dims = node.outputs[0].shape.dims
-            if dims[1].size % self.model_deg == 0:
+            if self.model_deg > 1 and dims[1].size % self.model_deg == 0:
                 assign = list(_dp_assign(ndim, batch_ok,
                                          batch_axes=self.batch_axes))
                 assign[1] = (AXIS_MODEL,)
@@ -202,12 +224,19 @@ class UnitySearch:
             # pass-through configs so TP activations can stay sharded
             # across elementwise/norm ops between a col/row pair
             dims = node.outputs[0].shape.dims
-            if dims[-1].size % self.model_deg == 0:
+            if self.model_deg > 1 and dims[-1].size % self.model_deg == 0:
                 out.append(NodeConfig(
                     "feat", _dp_assign(ndim, batch_ok,
                                        batch_axes=self.batch_axes,
                                        last_axes=(AXIS_MODEL,)),
                 ))
+            if (ndim == 3 and allow_seq
+                    and dims[1].size % self.seq_deg == 0):
+                # seq-sharded pass-through between ring-attention ops
+                assign = list(_dp_assign(ndim, batch_ok,
+                                         batch_axes=self.batch_axes))
+                assign[1] = (AXIS_SEQ,)
+                out.append(NodeConfig("sp", tuple(assign)))
         return out
 
     # ---------------------------------------------------- strategy evaluation
@@ -289,6 +318,21 @@ class UnitySearch:
                     cfg.out_assign, self.axis_sizes) * dtype_bytes(out_pt.dtype)
                 psum += self.cm.machine.all_reduce(shard_bytes, ax)
             comm_axes = tuple(cfg.psum_axes)
+            if (cfg.name == "sp"
+                    and node.op_type == OT.OP_MULTIHEAD_ATTENTION):
+                # ring attention's defining cost: K and V blocks rotate
+                # (seq_deg − 1) neighbor hops per forward, and the backward
+                # re-rotates them (≈2× fwd) — priced as ppermute traffic of
+                # the local activation block (parallel/ring_attention.py)
+                out_pt = node.outputs[0]
+                local_bytes = _shard_elems(
+                    tuple(d.size for d in out_pt.shape.dims
+                          if not d.is_replica_dim),
+                    cfg.out_assign, self.axis_sizes) * dtype_bytes(out_pt.dtype)
+                hops = 2 * (self.seq_deg - 1)  # K and V, fwd
+                psum += 3.0 * hops * self.cm.machine.ppermute(
+                    local_bytes, AXIS_SEQ)
+                comm_axes = comm_axes + (AXIS_SEQ,)
             if not comm_axes and cm.sync_time > 0:
                 comm_axes = (AXIS_DATA,)  # gradient allreduce rides `data`
             acc.add(node.guid,
@@ -319,7 +363,7 @@ class UnitySearch:
             # tp_conv included: an O-sharded kernel consumes the FULL input
             # channels, so a chan-sharded producer pays a real all-gather
             return _dp_assign(ndim, True, batch_axes=self.batch_axes)
-        if cfg.name in ("feat", "chan") and len(cfg.out_assign) == ndim:
+        if cfg.name in ("feat", "chan", "sp") and len(cfg.out_assign) == ndim:
             # pass-through configs consume their own (sharded) layout
             return cfg.out_assign
         return None
