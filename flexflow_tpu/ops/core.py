@@ -291,14 +291,27 @@ def _ln_weights(p: LayerNormParams, in_shapes):
 def _ln_forward(p: LayerNormParams, inputs, weights, state, ctx):
     (x,) = inputs
     axes = tuple(a % x.ndim for a in p.axes)
+    if p.elementwise_affine:
+        # fused Pallas kernel for the tiling-friendly common case (one
+        # HBM pass instead of XLA's off-roofline convert+reduce fusion;
+        # kernels/layer_norm.py)
+        from ..kernels.layer_norm import fused_layer_norm_or_none
+
+        fused = fused_layer_norm_or_none(
+            x, weights["scale"], weights["bias"], axes, p.eps)
+        if fused is not None:
+            return [fused], state
     xf = x.astype(jnp.float32)  # fp32 statistics under mixed precision
     mean = jnp.mean(xf, axes, keepdims=True)
     var = jnp.var(xf, axes, keepdims=True)
-    y = ((xf - mean) * jax.lax.rsqrt(var + p.eps)).astype(x.dtype)
+    y = (xf - mean) * jax.lax.rsqrt(var + p.eps)
     if p.elementwise_affine:
+        # affine still in f32 (matching the fused kernel's semantics; a
+        # bf16·f32 product would also silently promote activations), one
+        # final cast to the activation dtype
         bshape = [x.shape[a] if a in axes else 1 for a in range(x.ndim)]
         y = y * weights["scale"].reshape(bshape) + weights["bias"].reshape(bshape)
-    return [y], state
+    return [y.astype(x.dtype)], state
 
 
 register_op(OpDef(OT.OP_LAYERNORM, _ln_infer, _ln_forward, _ln_weights))

@@ -174,3 +174,66 @@ def test_flash_backward_bf16():
         np.testing.assert_allclose(
             np.asarray(a, np.float32), np.asarray(b_), rtol=0.1, atol=0.5
         )
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_fused_layer_norm_matches_reference(dtype):
+    """kernels/layer_norm.py fwd + bwd vs the jnp reference formula."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.kernels.layer_norm import fused_layer_norm_or_none
+
+    rs = np.random.RandomState(0)
+    n, d = 512, 256
+    x = jnp.asarray(rs.randn(2, n // 2, d), dtype)
+    scale = jnp.asarray(rs.randn(d) * 0.5 + 1.0, jnp.float32)
+    bias = jnp.asarray(rs.randn(d) * 0.1, jnp.float32)
+    eps = 1e-5
+
+    def ref(x, scale, bias):
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(-1, keepdims=True)
+        var = xf.var(-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+        return y.astype(x.dtype)
+
+    def fused(x, scale, bias):
+        out = fused_layer_norm_or_none(x, scale, bias, (-1,), eps)
+        assert out is not None
+        return out
+
+    tol = dict(rtol=1e-5, atol=1e-5) if dtype == "float32" else dict(
+        rtol=2e-2, atol=2e-2)
+    y_f = jax.jit(fused)(x, scale, bias)
+    y_r = jax.jit(ref)(x, scale, bias)
+    np.testing.assert_allclose(np.asarray(y_f, np.float32),
+                               np.asarray(y_r, np.float32), **tol)
+
+    g = jnp.asarray(rs.randn(2, n // 2, d), dtype)
+
+    def loss(f):
+        def inner(x, scale, bias):
+            return jnp.sum(f(x, scale, bias).astype(jnp.float32)
+                           * g.astype(jnp.float32))
+        return inner
+
+    gf = jax.jit(jax.grad(loss(fused), argnums=(0, 1, 2)))(x, scale, bias)
+    gr = jax.jit(jax.grad(loss(ref), argnums=(0, 1, 2)))(x, scale, bias)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), **tol)
+
+
+def test_fused_layer_norm_gates_to_fallback():
+    """Ragged / non-last-axis shapes return None (jnp fallback)."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.kernels.layer_norm import fused_layer_norm_or_none
+
+    x = jnp.zeros((8, 100))  # d % 128 != 0
+    s = jnp.ones((100,)); b = jnp.zeros((100,))
+    assert fused_layer_norm_or_none(x, s, b, (-1,), 1e-5) is None
+    x2 = jnp.zeros((8, 16, 128))
+    s2 = jnp.ones((16,)); b2 = jnp.zeros((16,))
+    assert fused_layer_norm_or_none(x2, s2, b2, (1,), 1e-5) is None
