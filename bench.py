@@ -106,6 +106,10 @@ def main():
 
         return loop
 
+    # the warmup loop is load-bearing beyond warmup: its OUTPUT arrays have
+    # executable-result layouts, so the timed executable compiles once for
+    # those and its second call hits the cache — feeding fresh device_put
+    # arrays directly makes the timed call recompile (~40s on-clock).
     warm_loop = run_n(warmup)
     st, rng, _ = warm_loop(state, rng)
     jax.block_until_ready(st[0])

@@ -419,6 +419,69 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _bwd_single_tile_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dk_ref, dv_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+    seq_q: int, seq_k: int, causal_offset: int,
+):
+    """Whole-sequence backward in ONE kernel (seq fits a single tile): the
+    probability tile and ds are computed once and reused for dq, dk, AND
+    dv — the split dq/dkv FA2 kernels each recompute them, costing a
+    second exp pass over the logits tile. At short-to-medium sequence this
+    is the dominant backward cost (the kernels are VPU-bound, like the
+    forward)."""
+    zero = jnp.zeros((), jnp.int32)
+    q, k, v, do, p, ds = _bwd_tile(
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, zero, zero,
+        True,  # single tile is always the diagonal tile under causal
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        seq_q=seq_q, seq_k=seq_k, causal_offset=causal_offset,
+        # invariant of this kernel: the caller fixes block == seq, so
+        # there are never padded q rows to mask
+        mask_q_rows=False,
+    )
+    dq_ref[0] = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dq_ref.dtype)
+    dk_ref[0] = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dk_ref.dtype)
+    dv_ref[0] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dv_ref.dtype)
+
+
+def _flash_bwd_single_tile(qf, kf, vf, gf, lse, delta, causal, scale,
+                           s_q, s_k, d, bh):
+    spec = pl.BlockSpec((1, s_q, d), lambda i: (i, 0, 0))
+    kspec = pl.BlockSpec((1, s_k, d), lambda i: (i, 0, 0))
+    rowspec = pl.BlockSpec((1, s_q, LSE_LANES), lambda i: (i, 0, 0))
+    return pl.pallas_call(
+        functools.partial(
+            _bwd_single_tile_kernel, scale=scale, causal=causal,
+            block_q=s_q, block_k=s_k, seq_q=s_q, seq_k=s_k,
+            causal_offset=s_k - s_q,
+        ),
+        grid=(bh,),
+        in_specs=[spec, kspec, kspec, spec, rowspec, rowspec],
+        out_specs=[spec, kspec, kspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), qf.dtype),
+            jax.ShapeDtypeStruct((bh, s_k, d), kf.dtype),
+            jax.ShapeDtypeStruct((bh, s_k, d), vf.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=jax.default_backend() != "tpu",
+        name="flash_attention_bwd_fused",
+    )(qf, kf, vf, gf, lse, delta)
+
+
 def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
@@ -437,6 +500,14 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     interpret = jax.default_backend() != "tpu"
     ni = pl.cdiv(s_q, bq)
     nj = pl.cdiv(s_k, bk)
+    if ni == 1 and nj == 1:
+        dq, dk, dv = _flash_bwd_single_tile(
+            qf, kf, vf, gf, lse, delta, causal, scale, s_q, s_k, d, b * h)
+        return (
+            dq.reshape(b, h, s_q, d),
+            dk.reshape(b, h, s_k, d),
+            dv.reshape(b, h, s_k, d),
+        )
     common = dict(
         scale=scale, causal=causal, block_q=bq, block_k=bk,
         seq_q=s_q, seq_k=s_k, causal_offset=s_k - s_q,

@@ -103,7 +103,10 @@ def test_flash_attention_cross_causal_alignment():
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("sq,sk", [(256, 256), (320, 320), (128, 256), (320, 192)])
+# (128, 128) with block 128 exercises the fused single-tile backward
+# (ni == nj == 1 — the benchmark's own seq==block configuration)
+@pytest.mark.parametrize(
+    "sq,sk", [(256, 256), (320, 320), (128, 256), (320, 192), (128, 128)])
 def test_flash_backward_matches_reference(causal, sq, sk):
     """Pallas dq/dk/dv kernels vs XLA autodiff of the reference attention,
     including ragged and cross-length causal shapes."""
