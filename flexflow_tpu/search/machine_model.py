@@ -79,12 +79,17 @@ class TPUMachineModel:
     axis_sizes: dict  # axis name -> size
     axis_links: dict | None = None
     axis_over_dcn: frozenset = frozenset()
+    # per-axis effective-bandwidth derating for shared/contended paths —
+    # the EnhancedMachineModel congestion knob (simulator.h:279) recast:
+    # 1.0 = dedicated links, >1 divides the axis's bandwidth
+    axis_congestion: dict | None = None
 
     def _bw(self, axis: str) -> float:
+        cong = (self.axis_congestion or {}).get(axis, 1.0)
         if axis in self.axis_over_dcn:
-            return self.chip.dcn_bandwidth
+            return self.chip.dcn_bandwidth / cong
         links = (self.axis_links or {}).get(axis, 1)
-        return self.chip.ici_bandwidth * links
+        return self.chip.ici_bandwidth * links / cong
 
     def _lat(self, axis: str) -> float:
         return (self.chip.dcn_latency if axis in self.axis_over_dcn
@@ -137,7 +142,10 @@ def machine_model_from_file(path: str, mesh) -> TPUMachineModel:
                   "hbm_bytes": ..., "ici_bandwidth": ..., "ici_links": ...,
                   ["ici_latency", "dcn_bandwidth", "dcn_latency"]},
        "axis_links": {"data": 2, ...},    # torus links per mesh axis (opt)
-       "dcn_axes": ["dcn"]}               # axes that ride DCN (opt)
+       "dcn_axes": ["dcn"],               # axes that ride DCN (opt)
+       "congestion": {"dcn": 2.0}}        # per-axis bandwidth derating
+                                          # (EnhancedMachineModel's
+                                          # congestion, simulator.h:279)
     """
     import json
 
@@ -182,7 +190,17 @@ def machine_model_from_file(path: str, mesh) -> TPUMachineModel:
     # (same auto-marking as machine_model_for_mesh)
     over_dcn = {a for a in data.get("dcn_axes", ()) if a in axis_sizes}
     over_dcn |= {a for a in axis_sizes if a == AXIS_DCN}
-    return TPUMachineModel(chip, axis_sizes, links, frozenset(over_dcn))
+    congestion = {a: float(v) for a, v in data.get("congestion", {}).items()
+                  if a in axis_sizes}
+    bad = {a: v for a, v in congestion.items() if v < 1.0}
+    if bad:
+        # reject rather than silently clamp: a fractional value usually
+        # means the user meant link efficiency (the inverse convention)
+        raise ValueError(
+            f"machine model file {path}: congestion factors must be >= 1 "
+            f"(bandwidth derating), got {bad}")
+    return TPUMachineModel(chip, axis_sizes, links, frozenset(over_dcn),
+                           congestion or None)
 
 
 def machine_model_for_mesh(mesh, chip: ChipSpec | None = None,

@@ -141,3 +141,35 @@ def test_parity_only_flags_warn(capsys):
     FFConfig()
     err = capsys.readouterr().err
     assert "no effect" in err
+
+
+def test_machine_model_congestion(tmp_path):
+    """Per-axis congestion derating (EnhancedMachineModel analog)."""
+    from flexflow_tpu.machine import build_mesh, MeshShape
+    from flexflow_tpu.search.machine_model import machine_model_from_file
+
+    mesh = build_mesh(MeshShape((2, 4, 1, 1)))
+    p = tmp_path / "mm.json"
+    p.write_text(json.dumps({"chip": "v5p",
+                             "congestion": {"model": 2.0}}))
+    m = machine_model_from_file(str(p), mesh)
+    p2 = tmp_path / "mm2.json"
+    p2.write_text(json.dumps({"chip": "v5p"}))
+    m2 = machine_model_from_file(str(p2), mesh)
+    # congested axis prices 2x the bytes-proportional part
+    free = m2.all_reduce(1e9, "model")
+    congested = m.all_reduce(1e9, "model")
+    assert congested > 1.8 * free
+    assert m.all_reduce(1e9, "data") == m2.all_reduce(1e9, "data")
+
+
+def test_machine_model_rejects_fractional_congestion(tmp_path):
+    from flexflow_tpu.machine import build_mesh, MeshShape
+    from flexflow_tpu.search.machine_model import machine_model_from_file
+
+    mesh = build_mesh(MeshShape((2, 4, 1, 1)))
+    p = tmp_path / "mm.json"
+    p.write_text(json.dumps({"chip": "v5p",
+                             "congestion": {"model": 0.5}}))
+    with pytest.raises(ValueError, match="congestion"):
+        machine_model_from_file(str(p), mesh)
