@@ -121,13 +121,27 @@ def main():
     timed_loop = run_n(steps)
     st, rng, _ = timed_loop(st, rng)
     jax.block_until_ready(st[0])
-    t0 = time.perf_counter()
-    st2, _, _ = timed_loop(st, rng)
-    jax.block_until_ready(st2[0])
-    dt = time.perf_counter() - t0
 
-    tokens_per_sec = steps * batch * cfg.sequence_length / dt
-    mfu = tokens_per_sec * transformer_lm_flops_per_token(cfg) / _peak_flops(dev)
+    def measure(st, rng):
+        t0 = time.perf_counter()
+        st2, rng2, _ = timed_loop(st, rng)
+        jax.block_until_ready(st2[0])
+        return time.perf_counter() - t0, st2, rng2
+
+    flops_per_token = transformer_lm_flops_per_token(cfg)
+    peak = _peak_flops(dev)
+    # guard against measurement flukes (the tunneled backend occasionally
+    # acks a dispatch without executing, reading as >>100% MFU — physically
+    # impossible): retry up to 3 times until the reading is plausible
+    for _ in range(3):
+        dt, st, rng = measure(st, rng)
+        tokens_per_sec = steps * batch * cfg.sequence_length / dt
+        mfu = tokens_per_sec * flops_per_token / peak
+        if not on_tpu or mfu <= 1.0:
+            break
+    else:
+        print("bench: all retries read >100% MFU — backend measurement "
+              "fluke, result is NOT trustworthy", file=sys.stderr)
     print(json.dumps({
         "metric": "transformer_lm_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),

@@ -190,8 +190,14 @@ def machine_model_from_file(path: str, mesh) -> TPUMachineModel:
     # (same auto-marking as machine_model_for_mesh)
     over_dcn = {a for a in data.get("dcn_axes", ()) if a in axis_sizes}
     over_dcn |= {a for a in axis_sizes if a == AXIS_DCN}
-    congestion = {a: float(v) for a, v in data.get("congestion", {}).items()
-                  if a in axis_sizes}
+    unknown = [a for a in data.get("congestion", {}) if a not in axis_sizes]
+    if unknown:
+        # a typoed axis name must not silently price as uncongested (same
+        # strictness as the unknown-chip check above)
+        raise ValueError(
+            f"machine model file {path}: congestion axes {unknown} not in "
+            f"the mesh (have {sorted(axis_sizes)})")
+    congestion = {a: float(v) for a, v in data.get("congestion", {}).items()}
     bad = {a: v for a, v in congestion.items() if v < 1.0}
     if bad:
         # reject rather than silently clamp: a fractional value usually

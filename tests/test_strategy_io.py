@@ -173,3 +173,15 @@ def test_machine_model_rejects_fractional_congestion(tmp_path):
                              "congestion": {"model": 0.5}}))
     with pytest.raises(ValueError, match="congestion"):
         machine_model_from_file(str(p), mesh)
+
+
+def test_machine_model_rejects_unknown_congestion_axis(tmp_path):
+    from flexflow_tpu.machine import build_mesh, MeshShape
+    from flexflow_tpu.search.machine_model import machine_model_from_file
+
+    mesh = build_mesh(MeshShape((2, 4, 1, 1)))
+    p = tmp_path / "mm.json"
+    p.write_text(json.dumps({"chip": "v5p",
+                             "congestion": {"mdoel": 4.0}}))  # typo
+    with pytest.raises(ValueError, match="congestion axes"):
+        machine_model_from_file(str(p), mesh)
