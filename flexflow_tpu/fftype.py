@@ -96,9 +96,11 @@ class CompMode(enum.IntEnum):
 
 
 class ParameterSyncType(enum.IntEnum):
-    """Kept for API parity. On TPU both PS and NCCL sync lower to the same
-    XLA collective (psum over the data axes), chosen by GSPMD from shardings;
-    reference: include/flexflow/ffconst.h:52-56."""
+    """Kept for API parity (reference: include/flexflow/ffconst.h:52-56).
+    On TPU NCCL-mode sync lowers to an XLA psum over the data axes, chosen
+    by GSPMD from shardings. PS (hub-and-spoke parameter server,
+    optimizer_kernel.cu:48-76) is rejected at tensor construction: a psum
+    riding ICI strictly dominates it on TPU (SURVEY §7)."""
 
     NONE = 80
     PS = 81

@@ -592,7 +592,12 @@ def flash_attention(
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     s_q, s_k, d = q.shape[2], k.shape[2], q.shape[3]
-    # shape gate: tiny/ragged shapes go to the XLA path (still fused by XLA)
-    if s_q < 128 or s_k < 128 or d % 8 != 0:
+    # shape gate: tiny/ragged shapes go to the XLA path (still fused by XLA).
+    # causal with s_q > s_k also routes there: rows with zero live keys
+    # (q_pos + offset < 0) would read m = -inf and p = exp(0) = 1 in the
+    # multi-kv online softmax — averaging V over live tiles only and
+    # emitting a bogus lse — instead of sdpa_xla's uniform-over-all-keys
+    # convention for that degenerate shape.
+    if s_q < 128 or s_k < 128 or d % 8 != 0 or (causal and s_q > s_k):
         return _attn_reference(q, k, v, causal, scale)
     return _flash(q, k, v, causal, scale, block_q, block_k)

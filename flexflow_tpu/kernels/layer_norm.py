@@ -134,7 +134,12 @@ def fused_layer_norm_or_none(x, scale, bias, axes, eps):
     n = 1
     for s in x.shape[:-1]:
         n *= s
-    if d % 128 != 0 or n % min(_ROW_BLOCK, n) != 0 or n < 8:
+    # rows must divide into 8-sublane-aligned blocks: `n % min(_ROW_BLOCK, n)`
+    # alone is vacuous for n < _ROW_BLOCK (n % n == 0) and a 12-row or
+    # 100-row block would fail Mosaic's 8-sublane tiling on real TPU
+    # (interpret-mode CPU tests can't catch that)
+    rb = min(_ROW_BLOCK, n)
+    if d % 128 != 0 or n < 8 or rb % 8 != 0 or n % rb != 0:
         return None
     y2 = _fused_ln(x.reshape(n, d), scale, bias, float(eps))
     return y2.reshape(x.shape)

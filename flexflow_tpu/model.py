@@ -757,11 +757,16 @@ class FFModel:
             self._assign_strategy()
         if self.config.export_strategy_file:
             # persist the plan in effect (searched or imported) for replay
-            # (--export-strategy, model.cc:3599-3608)
-            from .parallel.strategies import Strategy
+            # (--export-strategy, model.cc:3599-3608); only the coordinator
+            # writes — in a multi-host run every process reaches this point
+            # and all hosts would race on the same shared-filesystem path
+            from .distributed import is_coordinator
 
-            Strategy(self._strategy or {}).save(
-                self.config.export_strategy_file)
+            if is_coordinator():
+                from .parallel.strategies import Strategy
+
+                Strategy(self._strategy or {}).save(
+                    self.config.export_strategy_file)
         if self.config.export_strategy_computation_graph_file:
             from .pcg.graph import export_dot
 
@@ -815,6 +820,21 @@ class FFModel:
         batch_deg = 1
         for ax in batch_axes:
             batch_deg *= self.mesh.shape.get(ax, 1)
+        if self._strategy:
+            # a broadcast/imported plan can carry names from a REWRITTEN
+            # graph (e.g. the fused Experts node from fuse_moe_trio) that
+            # don't exist in this graph; silently dropping them would fall
+            # back to data parallel for those ops with no sign anything was
+            # lost — make the mismatch visible
+            present = {n.name for n in self.graph.topo_order()}
+            dropped = sorted(set(self._strategy) - present)
+            if dropped:
+                import warnings
+
+                warnings.warn(
+                    "strategy contains placements for nodes not in this "
+                    f"graph (dropped, falling back to data parallel): "
+                    f"{dropped}", stacklevel=2)
         for node in self.graph.topo_order():
             ov = (self._strategy or {}).get(node.name, {})
             if node.is_parallel_op and node.inputs:

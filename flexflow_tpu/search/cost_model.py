@@ -129,10 +129,18 @@ def price_parallel_node(node, machine) -> tuple[float, tuple]:
     def _degree_axis(degree: int) -> str:
         from ..machine import AXIS_MODEL
 
+        # several mesh axes can share a size (dcn=2, model=2 on a 2-host
+        # mesh); an explicit parallel op's collective rides ICI, so prefer
+        # non-DCN axes — matching on the leading `dcn` axis would price a
+        # tensor-parallel Combine at DCN bandwidth (~10× slow) and make the
+        # search systematically reject model-parallel rewrites multi-host
+        fallback = None
         for ax, size in machine.axis_sizes.items():
             if size == degree:
-                return ax
-        return AXIS_MODEL
+                if ax not in machine.axis_over_dcn:
+                    return ax
+                fallback = fallback or ax
+        return fallback or AXIS_MODEL
 
     for st, sp in subs:
         if st == OT.OP_COMBINE:

@@ -174,6 +174,18 @@ class ParallelTensor:
         self.parallel_tensor_guid = next(_parallel_tensor_guid)
         self.shape = shape
         self.name = name or f"ptensor_{self.parallel_tensor_guid}"
+        if sync_type == ParameterSyncType.PS:
+            # the reference's parameter-server sync (gather to one GPU,
+            # optimizer_kernel.cu:48-76) is deliberately not implemented:
+            # on TPU gradient sync is a GSPMD-inserted psum riding ICI,
+            # which strictly dominates a hub-and-spoke PS exchange. Reject
+            # loudly rather than silently run NCCL-equivalent sync under a
+            # PS label (SURVEY §7 decision).
+            raise NotImplementedError(
+                "ParameterSyncType.PS is not supported on TPU: gradient "
+                "synchronization is an XLA psum over the data mesh axes "
+                "(the NCCL-mode equivalent); use ParameterSyncType.NCCL "
+                "or NONE")
         self.sync_type = sync_type
         self.create_gradients = create_gradients
         self.axis_assignment: tuple[tuple[str, ...], ...] = tuple(

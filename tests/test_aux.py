@@ -133,3 +133,32 @@ def test_profiling_prints_per_op_table(capsys):
     ff.fit(rs.randn(16, 32).astype(np.float32),
            rs.randint(0, 10, (16, 1)).astype(np.int32), epochs=1)
     assert "prof_fc1" not in capsys.readouterr().out
+
+
+def test_ps_sync_rejected():
+    """ParameterSyncType.PS raises loudly (hub-and-spoke PS is strictly
+    dominated by a psum over ICI; the decision must not be silent —
+    optimizer_kernel.cu:48-76 is the reference's PS path)."""
+    from flexflow_tpu.fftype import DataType, ParameterSyncType
+    from flexflow_tpu.tensor import ParallelTensor, ParallelTensorShape
+
+    shape = ParallelTensorShape.from_shape((4, 4), DataType.DT_FLOAT)
+    with pytest.raises(NotImplementedError, match="psum"):
+        ParallelTensor(shape, sync_type=ParameterSyncType.PS)
+    # NCCL and NONE still construct
+    ParallelTensor(shape, sync_type=ParameterSyncType.NCCL)
+    ParallelTensor(shape, sync_type=ParameterSyncType.NONE)
+
+
+def test_strategy_unknown_node_names_warn():
+    """A strategy carrying node names absent from the graph (e.g. rewrite-
+    generated names broadcast to a host that didn't rewrite) warns instead
+    of silently dropping placements (ADVICE r4)."""
+    import warnings
+
+    ff = _mlp()
+    ff._strategy = {"no_such_node": {"outputs": {}, "weights": {}}}
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ff._assign_strategy()
+    assert any("no_such_node" in str(x.message) for x in w)
