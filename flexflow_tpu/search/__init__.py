@@ -18,5 +18,10 @@ simulator (graph.cc, substitution.cc, simulator.cc). The TPU-native recast:
 """
 
 from .cost_model import CostMetrics, CostModel, classify_reshard
-from .machine_model import TPUMachineModel, machine_model_for_mesh
+from .machine_model import (
+    AxisTopology,
+    TorusMachineModel,
+    TPUMachineModel,
+    machine_model_for_mesh,
+)
 from .unity import UnitySearch, mcmc_search_strategy, search_strategy

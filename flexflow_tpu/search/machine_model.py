@@ -124,11 +124,157 @@ class TPUMachineModel:
     def ppermute(self, bytes_per_chip: float, axis: str) -> float:
         return bytes_per_chip / self._bw(axis) + self._lat(axis)
 
+    def rotate(self, bytes_per_chip: float, axis: str) -> float:
+        """One ring-rotation step (every chip shifts to its +1 neighbor,
+        INCLUDING the wrap pair) — ring attention's K/V hop. On the uniform
+        model this equals ppermute; TorusMachineModel prices the wrap edge
+        of a non-wraparound axis as a serialized multi-hop traversal."""
+        return self.ppermute(bytes_per_chip, axis)
+
     def compute_time(self, flops: float, bytes_touched: float) -> float:
         """Roofline: max of MXU time and HBM time (the simulator's measured
         per-op µs analog; see CostModel.calibrate for the measured path)."""
         return max(flops / self.chip.peak_flops,
                    bytes_touched / self.chip.hbm_bandwidth)
+
+
+@dataclass(frozen=True)
+class AxisTopology:
+    """Physical shape of one mesh axis on the interconnect.
+
+    The NetworkedMachineModel topology analog (simulator.h:212-615,
+    network.cc:1-586 — arbitrary adjacency + ECMP shortest-path routing)
+    specialized to what TPU fabrics actually are: each mesh axis maps onto
+    one or more torus dimensions (`links` physical links per chip serve the
+    axis), each either wrapped (full-pod torus dimension) or open (a
+    sub-slice is a mesh, not a torus — no wraparound links). Routing on a
+    1-D ring/line is shortest-path by construction, so the ECMP machinery
+    reduces to closed forms (see TorusMachineModel)."""
+
+    links: int = 1
+    wraparound: bool = True
+    over_dcn: bool = False
+
+
+@dataclass
+class TorusMachineModel(TPUMachineModel):
+    """Topology-aware collective pricing on a (partial) torus.
+
+    Where TPUMachineModel treats every axis as a uniform abstract pipe,
+    this model derives collective costs from the axis's physical topology
+    (the NetworkedMachineModel/EnhancedMachineModel analog,
+    simulator.h:212-615 + network.cc routing, recast to torus closed forms
+    instead of per-packet ECMP simulation):
+
+    - ring collectives (all_gather / reduce_scatter / all_reduce) on a
+      WRAPPED axis use both ring directions (half the payload each way):
+      2× the effective bandwidth of an open (non-wraparound) axis, where
+      the missing wrap link leaves only the one-directional-ring schedule;
+    - all_to_all pays minimal-route hop·bytes transit spread over the
+      axis's link-directions: mean hop distance n/4 on a wrapped ring vs
+      (n²−1)/3n on an open line — long axes without wraparound get
+      markedly more expensive, exactly the signal a flat model misses;
+    - rotate (ring attention's K/V shift) is one neighbor hop everywhere
+      on a wrapped axis, but on an open axis the wrap pair must traverse
+      the whole line against traffic: (n−1) serialized hops;
+    - DCN axes model per-host NIC fan-in: all `chips_per_host` chips of a
+      host issue their cross-slice transfers through ONE shared NIC, so
+      per-chip DCN bandwidth divides by the fan-in (the shared-bottleneck
+      congestion the reference prices via per-path contention counts,
+      machine_model.cc:1-1287).
+    """
+
+    topology: dict | None = None       # axis -> AxisTopology
+    chips_per_host: int = 1            # DCN NIC fan-in
+
+    def _topo(self, axis: str) -> AxisTopology:
+        t = (self.topology or {}).get(axis)
+        if t is not None:
+            return t
+        return AxisTopology(links=(self.axis_links or {}).get(axis, 1),
+                            wraparound=True,
+                            over_dcn=axis in self.axis_over_dcn)
+
+    def _cong(self, axis: str) -> float:
+        return (self.axis_congestion or {}).get(axis, 1.0)
+
+    def _link_bw(self, axis: str) -> float:
+        """Per-direction bandwidth × parallel links serving the axis."""
+        t = self._topo(axis)
+        if t.over_dcn:
+            # shared per-host NIC: every chip on the host pushes its own
+            # cross-slice stream through it simultaneously
+            return self.chip.dcn_bandwidth / (
+                max(1, self.chips_per_host) * self._cong(axis))
+        return self.chip.ici_bandwidth * t.links / self._cong(axis)
+
+    def _ring_bw(self, axis: str) -> float:
+        """Effective ring-schedule bandwidth: a wrapped axis runs the
+        bidirectional ring (payload halved each way)."""
+        t = self._topo(axis)
+        bw = self._link_bw(axis)
+        if t.over_dcn:
+            return bw  # DCN is switched, not a torus: direction-agnostic
+        return bw * (2 if t.wraparound else 1)
+
+    def _lat(self, axis: str) -> float:
+        return (self.chip.dcn_latency if self._topo(axis).over_dcn
+                else self.chip.ici_latency)
+
+    def all_gather(self, out_bytes: float, axis: str) -> float:
+        n = self.axis_size(axis)
+        if n <= 1:
+            return 0.0
+        return ((n - 1) / n * out_bytes / self._ring_bw(axis)
+                + (n - 1) * self._lat(axis))
+
+    def all_reduce(self, bytes_per_chip: float, axis: str) -> float:
+        n = self.axis_size(axis)
+        if n <= 1:
+            return 0.0
+        return (2.0 * (n - 1) / n * bytes_per_chip / self._ring_bw(axis)
+                + 2 * (n - 1) * self._lat(axis))
+
+    def all_to_all(self, send_bytes_per_chip: float, axis: str) -> float:
+        """Minimal-route transit: chip i sends B/n to each j over d(i,j)
+        hops; total hop·bytes spreads over the axis's link-directions.
+        Wrapped ring (even n): Σ_j d(i,j) = n²/4, 2n link-dirs
+          → time = B·n / (8·link_bw).
+        Open line: Σ_{i,j} d = n(n²−1)/3, 2(n−1) link-dirs
+          → time = B·(n+1) / (6·link_bw).
+        DCN (switched): every byte leaves the host once — the uniform
+        (n−1)/n·B over the fan-in-derated NIC bandwidth."""
+        n = self.axis_size(axis)
+        if n <= 1:
+            return 0.0
+        t = self._topo(axis)
+        bw = self._link_bw(axis)
+        lat = self._lat(axis)
+        if t.over_dcn:
+            return (n - 1) / n * send_bytes_per_chip / bw + (n - 1) * lat
+        if t.wraparound:
+            # total transit B·n²/4 over 2n link-dirs (odd n: (n²−1)/4,
+            # folded into the even form — off by <2% at n≥5)
+            time = send_bytes_per_chip * n / (8 * bw)
+        else:
+            time = send_bytes_per_chip * (n + 1) / (6 * bw)
+        return time + (n - 1) * lat
+
+    def ppermute(self, bytes_per_chip: float, axis: str) -> float:
+        """Neighbor hop (no wrap edge) — pipeline stage hand-off."""
+        return bytes_per_chip / self._link_bw(axis) + self._lat(axis)
+
+    def rotate(self, bytes_per_chip: float, axis: str) -> float:
+        """Full ring rotation (wrap pair included). On an open axis the
+        wrap transfer traverses all n−1 links of the line serially while
+        they also carry the neighbor shifts — the whole step is gated by
+        that traversal."""
+        n = self.axis_size(axis)
+        t = self._topo(axis)
+        hop = bytes_per_chip / self._link_bw(axis) + self._lat(axis)
+        if t.over_dcn or t.wraparound or n <= 2:
+            return hop
+        return (n - 1) * hop
 
 
 def machine_model_from_file(path: str, mesh) -> TPUMachineModel:
@@ -143,9 +289,15 @@ def machine_model_from_file(path: str, mesh) -> TPUMachineModel:
                   ["ici_latency", "dcn_bandwidth", "dcn_latency"]},
        "axis_links": {"data": 2, ...},    # torus links per mesh axis (opt)
        "dcn_axes": ["dcn"],               # axes that ride DCN (opt)
-       "congestion": {"dcn": 2.0}}        # per-axis bandwidth derating
+       "congestion": {"dcn": 2.0},        # per-axis bandwidth derating
                                           # (EnhancedMachineModel's
                                           # congestion, simulator.h:279)
+       "topology": {"data": {"wraparound": false, "links": 2}},
+                                          # per-axis physical shape: open
+                                          # sub-slice axes vs wrapped torus
+                                          # dims (NetworkedMachineModel)
+       "chips_per_host": 4}               # DCN NIC fan-in (default: inferred
+                                          # from the mesh size / host count)
     """
     import json
 
@@ -205,12 +357,41 @@ def machine_model_from_file(path: str, mesh) -> TPUMachineModel:
         raise ValueError(
             f"machine model file {path}: congestion factors must be >= 1 "
             f"(bandwidth derating), got {bad}")
-    return TPUMachineModel(chip, axis_sizes, links, frozenset(over_dcn),
-                           congestion or None)
+    # "topology": {"axis": {"wraparound": bool, "links": int}} — the
+    # NetworkedMachineModel config surface; "chips_per_host" sets the DCN
+    # NIC fan-in. Unknown axis names rejected like congestion typos.
+    topo_cfg = data.get("topology", {})
+    unknown = [a for a in topo_cfg if a not in axis_sizes]
+    if unknown:
+        raise ValueError(
+            f"machine model file {path}: topology axes {unknown} not in "
+            f"the mesh (have {sorted(axis_sizes)})")
+    topology = {}
+    for a in axis_sizes:
+        spec = topo_cfg.get(a, {})
+        topology[a] = AxisTopology(
+            links=int(spec.get("links", links.get(a, 1))),
+            wraparound=bool(spec.get("wraparound", a not in over_dcn)),
+            over_dcn=a in over_dcn)
+    if "chips_per_host" in data:
+        chips_per_host = max(1, int(data["chips_per_host"]))
+    else:
+        # infer like machine_model_for_mesh: chips ÷ hosts (hosts = product
+        # of the DCN axes) — a file supplied just to tweak congestion must
+        # not silently drop the NIC fan-in derating
+        total = hosts = 1
+        for a, v in axis_sizes.items():
+            total *= v
+            if a in over_dcn:
+                hosts *= v
+        chips_per_host = max(1, total // hosts) if hosts > 1 else 1
+    return TorusMachineModel(
+        chip, axis_sizes, links, frozenset(over_dcn), congestion or None,
+        topology=topology, chips_per_host=chips_per_host)
 
 
 def machine_model_for_mesh(mesh, chip: ChipSpec | None = None,
-                           num_hosts: int = 1) -> TPUMachineModel:
+                           num_hosts: int = 1) -> TorusMachineModel:
     from ..machine import AXIS_DCN
 
     chip = chip or detect_chip()
@@ -229,4 +410,16 @@ def machine_model_for_mesh(mesh, chip: ChipSpec | None = None,
     if chip.ici_links >= 6 and ici_axes:
         big = max(ici_axes, key=lambda a: axis_sizes[a])
         links[big] = 2
-    return TPUMachineModel(chip, axis_sizes, links, frozenset(over_dcn))
+    # default topology: ICI axes are wrapped torus dimensions (full-pod
+    # slices wrap; declare open sub-slice axes via --machine-model-file),
+    # the DCN NIC is shared by every chip of a host
+    topology = {a: AxisTopology(links=links[a], wraparound=a not in over_dcn,
+                                over_dcn=a in over_dcn)
+                for a in axis_sizes}
+    total = 1
+    for v in axis_sizes.values():
+        total *= v
+    chips_per_host = max(1, total // max(1, num_hosts)) if num_hosts > 1 else 1
+    return TorusMachineModel(chip, axis_sizes, links, frozenset(over_dcn),
+                             topology=topology,
+                             chips_per_host=chips_per_host)

@@ -330,7 +330,10 @@ class UnitySearch:
                           if not d.is_replica_dim),
                     cfg.out_assign, self.axis_sizes) * dtype_bytes(out_pt.dtype)
                 hops = 2 * (self.seq_deg - 1)  # K and V, fwd
-                psum += 3.0 * hops * self.cm.machine.ppermute(
+                # rotate, not ppermute: the K/V shift includes the wrap
+                # pair, which a non-wraparound (open) seq axis pays as a
+                # full line traversal (TorusMachineModel.rotate)
+                psum += 3.0 * hops * self.cm.machine.rotate(
                     local_bytes, AXIS_SEQ)
                 comm_axes = comm_axes + (AXIS_SEQ,)
             if not comm_axes and cm.sync_time > 0:

@@ -1,0 +1,170 @@
+"""Topology-aware machine model (TorusMachineModel): wraparound vs open
+axes, multi-hop all_to_all routing, ring-rotation wrap-edge pricing, DCN
+NIC fan-in, and file loading — the NetworkedMachineModel/
+EnhancedMachineModel analog (reference simulator.h:212-615,
+network.cc:1-586, machine_model.cc:1-1287) recast to torus closed forms."""
+
+import json
+
+import pytest
+
+from flexflow_tpu.search.machine_model import (
+    CHIPS,
+    AxisTopology,
+    TorusMachineModel,
+    machine_model_for_mesh,
+    machine_model_from_file,
+)
+
+
+def _model(sizes, topology, chips_per_host=1):
+    return TorusMachineModel(CHIPS["v5e"], dict(sizes),
+                             topology=topology,
+                             axis_over_dcn=frozenset(
+                                 a for a, t in topology.items() if t.over_dcn),
+                             chips_per_host=chips_per_host)
+
+
+def test_wraparound_ring_beats_open_line():
+    # the VERDICT acceptance case: same bytes, same axis size — the wrapped
+    # axis runs the bidirectional ring, the open one cannot
+    wrapped = _model({"data": 8}, {"data": AxisTopology(wraparound=True)})
+    open_ = _model({"data": 8}, {"data": AxisTopology(wraparound=False)})
+    b = 1e8
+    assert wrapped.all_gather(b, "data") < open_.all_gather(b, "data")
+    assert wrapped.all_reduce(b, "data") < open_.all_reduce(b, "data")
+    # exactly the 2× ring-direction factor (latency terms are equal)
+    lat = 7 * wrapped._lat("data")
+    assert wrapped.all_gather(b, "data") - lat == pytest.approx(
+        (open_.all_gather(b, "data") - lat) / 2)
+
+
+def test_all_to_all_routing_torus_vs_line():
+    # mean hop distance n/4 (ring) vs ~n/3 (line) over fewer link-dirs:
+    # the open axis pays ~1.5× at n=8
+    wrapped = _model({"x": 8}, {"x": AxisTopology(wraparound=True)})
+    open_ = _model({"x": 8}, {"x": AxisTopology(wraparound=False)})
+    b = 1e8
+    t_w = wrapped.all_to_all(b, "x")
+    t_o = open_.all_to_all(b, "x")
+    assert t_w < t_o
+    assert t_o / t_w == pytest.approx(1.5, rel=0.05)
+
+
+def test_rotate_wrap_edge_serializes_on_open_axis():
+    # ring attention's K/V rotation: 1 hop on a torus, a full line
+    # traversal on an open axis (the wrap pair crosses all n−1 links)
+    n = 8
+    wrapped = _model({"seq": n}, {"seq": AxisTopology(wraparound=True)})
+    open_ = _model({"seq": n}, {"seq": AxisTopology(wraparound=False)})
+    b = 1e7
+    assert open_.rotate(b, "seq") == pytest.approx(
+        (n - 1) * wrapped.rotate(b, "seq"))
+    # the pipeline hand-off (ppermute, no wrap edge) is topology-blind
+    assert open_.ppermute(b, "seq") == wrapped.ppermute(b, "seq")
+
+
+def test_dcn_fan_in_shares_the_nic():
+    topo = {"dcn": AxisTopology(over_dcn=True, wraparound=False)}
+    alone = _model({"dcn": 4}, topo, chips_per_host=1)
+    shared = _model({"dcn": 4}, topo, chips_per_host=4)
+    b = 1e8
+    lat = 3 * alone._lat("dcn")
+    assert (shared.all_gather(b, "dcn") - lat) == pytest.approx(
+        4 * (alone.all_gather(b, "dcn") - lat))
+
+
+def test_links_multiply_bandwidth():
+    one = _model({"m": 4}, {"m": AxisTopology(links=1)})
+    two = _model({"m": 4}, {"m": AxisTopology(links=2)})
+    b = 1e8
+    lat = 3 * one._lat("m")
+    assert (two.all_gather(b, "m") - lat) == pytest.approx(
+        (one.all_gather(b, "m") - lat) / 2)
+
+
+def test_for_mesh_defaults_wrap_ici_not_dcn():
+    m = machine_model_for_mesh({"dcn": 2, "data": 4}, chip=CHIPS["v5e"],
+                               num_hosts=2)
+    assert isinstance(m, TorusMachineModel)
+    assert m._topo("data").wraparound
+    assert m._topo("dcn").over_dcn and not m._topo("dcn").wraparound
+    assert m.chips_per_host == 4  # 8 chips over 2 hosts
+
+
+def test_file_topology_roundtrip(tmp_path):
+    p = tmp_path / "mm.json"
+    p.write_text(json.dumps({
+        "chip": "v5e",
+        "topology": {"data": {"wraparound": False, "links": 2}},
+        "chips_per_host": 4,
+        "dcn_axes": ["dcn"],
+    }))
+    m = machine_model_from_file(str(p), {"dcn": 2, "data": 8, "model": 1})
+    assert isinstance(m, TorusMachineModel)
+    t = m._topo("data")
+    assert not t.wraparound and t.links == 2
+    assert m.chips_per_host == 4
+    # DCN all_gather reflects the fan-in derating
+    b = 1e8
+    n = 2
+    expect = (n - 1) / n * b / (m.chip.dcn_bandwidth / 4) + (n - 1) * m._lat("dcn")
+    assert m.all_gather(b, "dcn") == pytest.approx(expect)
+
+
+def test_file_topology_unknown_axis_rejected(tmp_path):
+    p = tmp_path / "mm.json"
+    p.write_text(json.dumps({"chip": "v5e",
+                             "topology": {"tyop": {"wraparound": False}}}))
+    with pytest.raises(ValueError, match="topology axes"):
+        machine_model_from_file(str(p), {"data": 8})
+
+
+def test_search_output_changes_with_topology(monkeypatch):
+    """The VERDICT acceptance: the search's decision flips with the axis
+    topology on the same mesh. A Linear with in=2048, out=4096, batch=1024
+    on an 8-wide model axis: tp_row saves 7/8 of the compute but pays a
+    ring all_reduce of the full output (~16.8 MB). On a wrapped axis the
+    bidirectional ring prices that psum below the compute savings (tp_row
+    wins); on an open axis it prices above them (dp wins)."""
+    import sys
+
+    monkeypatch.setattr(sys, "argv",
+                        ["test", "--enable-parameter-parallel",
+                         "--budget", "0"])
+    from test_joint_search import _pcg_of
+
+    from flexflow_tpu import ActiMode, FFConfig, FFModel
+    from flexflow_tpu.machine import build_mesh
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.unity import UnitySearch
+
+    config = FFConfig()
+    config.mesh_axis_sizes = (1, 8, 1, 1)
+    config.batch_size = 1024
+    ff = FFModel(config)
+    x = ff.create_tensor((1024, 2048), name="x")
+    ff.dense(x, 4096, ActiMode.AC_MODE_NONE, name="fc")
+    mesh = build_mesh(config.mesh_shape())
+
+    sizes = dict(mesh.shape)
+
+    def best_name(mm):
+        g = _pcg_of(ff)
+        us = UnitySearch(g, mesh, config, CostModel(mm))
+        fc = next(n for n in g.topo_order() if n.name == "fc")
+        costs = {}
+        for cfg in us.node_configs(fc):
+            t, _ = us.evaluate({fc.guid: cfg})
+            costs[cfg.name] = t
+        assert {"dp", "tp_row"} <= set(costs)
+        return min(costs, key=costs.get), costs
+
+    wrapped = _model(sizes, {a: AxisTopology(wraparound=True)
+                             for a in sizes})
+    open_ = _model(sizes, {a: AxisTopology(wraparound=False)
+                           for a in sizes})
+    w_best, w_costs = best_name(wrapped)
+    o_best, o_costs = best_name(open_)
+    assert w_costs["tp_row"] < w_costs["dp"], w_costs
+    assert o_costs["tp_row"] > o_costs["dp"], o_costs
