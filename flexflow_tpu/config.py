@@ -56,6 +56,11 @@ class FFConfig:
     # on-device cost-model calibration: measure the top-K distinct ops on
     # the local chip before searching (measure_operator_cost analog); 0=off
     search_calibrate: int = 0
+    # also search over mesh factorizations of the chip count (the
+    # MachineView grid-shape half of Unity — divisor degrees are reached by
+    # re-factorizing the mesh, search/mesh_search.py); the searched shape
+    # replaces the configured data/model split
+    search_mesh_shapes: bool = False
     # parallelism gates (reference config.h:133-137)
     only_data_parallel: bool = False
     enable_sample_parallel: bool = False
@@ -236,6 +241,8 @@ class FFConfig:
                 self.base_optimize_threshold = int(val())
             elif a == "--calibrate":
                 self.search_calibrate = int(val())
+            elif a == "--search-mesh-shapes":
+                self.search_mesh_shapes = True
             elif a == "--substitution-json":
                 self.substitution_json_path = val()
             elif a == "--enable-substitutions":

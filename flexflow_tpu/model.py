@@ -746,6 +746,49 @@ class FFModel:
 
                 self._strategy = run_search_on_host0(_search)
                 self._assign_strategy()
+            elif self.config.search_mesh_shapes:
+                # also search the mesh factorization itself (the MachineView
+                # grid-shape half of Unity, search/mesh_search.py): divisor
+                # degrees — a 2×4 hybrid on 8 chips — are reached by
+                # re-factorizing the data/model split, then the joint search
+                # runs per candidate shape. Calibration transfers: the
+                # measurements are per-op, mesh-independent.
+                from .machine import AXIS_SEQ, MeshShape
+                from .search.mesh_search import search_mesh_shapes
+
+                ms = self.config.mesh_shape()
+                fixed = {a: s for a, s in zip(ms.axis_names, ms.axis_sizes)
+                         if s > 1 and a not in (AXIS_DATA, AXIS_MODEL)}
+                if fixed:
+                    # factorizing around a pinned dcn/seq/pipe axis is not
+                    # modeled — refuse loudly rather than silently collapse
+                    # the configured axes to 1
+                    raise ValueError(
+                        f"--search-mesh-shapes factorizes the chip count "
+                        f"over (data, model) on a single slice; drop the "
+                        f"flag or the extra mesh axes {sorted(fixed)}")
+                machine_factory = None
+                if self.config.machine_model_file:
+                    # candidate machines must keep the file's topology/
+                    # congestion fidelity, not fall back to the analytic
+                    # defaults
+                    from .search.machine_model import machine_model_from_file
+
+                    machine_factory = lambda mesh: machine_model_from_file(  # noqa: E731
+                        self.config.machine_model_file, mesh)
+                _calibrate()
+                shape, g, choice, us, _ = search_mesh_shapes(
+                    g, n_devices, self.config, chip=machine.chip,
+                    num_hosts=self.config.num_nodes,
+                    calibrated=cost_model,
+                    machine_factory=machine_factory)
+                sizes = {a: 1 for a in ms.axis_names}
+                sizes.update(shape)
+                self.mesh = build_mesh(MeshShape(
+                    tuple(sizes[a] for a in ms.axis_names), ms.axis_names))
+                self.graph = g
+                self._strategy = us.to_strategy(choice).overrides
+                used_substitutions = True
             else:
                 _calibrate()
                 g, choice, us = joint_graph_optimize(

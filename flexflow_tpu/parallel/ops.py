@@ -44,33 +44,43 @@ from ..ops.base import OpDef, register_op
 @dataclass(frozen=True)
 class RepartitionParams:
     """Increase partition degree along `dim` by `degree`×
-    (partition.cc:132 create_input_partition)."""
+    (partition.cc:132 create_input_partition). `axes` optionally names the
+    mesh axes the new degree rides (their size product must equal
+    `degree`) — the MachineView device binding; empty = inferred from the
+    degree at assignment time."""
 
     dim: int
     degree: int
+    axes: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
 class CombineParams:
-    """Decrease partition degree along `dim` by `degree`× (combine.cc:135)."""
+    """Decrease partition degree along `dim` by `degree`× (combine.cc:135).
+    `axes` optionally names the mesh axes being freed."""
 
     dim: int
     degree: int
+    axes: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
 class ReplicateParams:
-    """Add a replica dim of extent `degree` (replicate.cc)."""
+    """Add a replica dim of extent `degree` (replicate.cc). `axes`
+    optionally names the mesh axes the replicas map onto."""
 
     degree: int
+    axes: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
 class ReductionParams:
     """Sum-reduce a replica dim of extent `degree` (reduction.cc: forward
-    kernel sums num_replicas slices — here XLA's psum)."""
+    kernel sums num_replicas slices — here XLA's psum). `axes` optionally
+    names the mesh axes summed over."""
 
     degree: int
+    axes: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -85,6 +95,7 @@ class ParallelOpInfo:
     op_type: OT
     dim: int
     degree: int
+    axes: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -100,20 +111,29 @@ def apply_parallel_op_shape(
 ) -> ParallelTensorShape:
     """IR shape transform for one parallel op (search rewrites use this)."""
     dims = list(shape.dims)
+    axes = getattr(params, "axes", ())
     if op_type == OT.OP_REPARTITION:
         d = dims[params.dim]
-        dims[params.dim] = replace(d, degree=d.degree * params.degree)
+        dims[params.dim] = replace(d, degree=d.degree * params.degree,
+                                   axes=d.axes + tuple(axes))
     elif op_type == OT.OP_COMBINE:
         d = dims[params.dim]
         if d.degree % params.degree != 0:
             raise ValueError(
                 f"combine degree {params.degree} does not divide {d.degree}"
             )
-        dims[params.dim] = replace(d, degree=d.degree // params.degree)
+        new_axes = d.axes
+        if axes and new_axes[-len(axes):] == tuple(axes):
+            new_axes = new_axes[:-len(axes)]
+        elif d.degree // params.degree == 1:
+            new_axes = ()
+        dims[params.dim] = replace(d, degree=d.degree // params.degree,
+                                   axes=new_axes)
     elif op_type == OT.OP_REPLICATE:
         dims.append(
             ParallelDim(
-                size=params.degree, degree=params.degree, is_replica_dim=True
+                size=params.degree, degree=params.degree,
+                is_replica_dim=True, axes=tuple(axes)
             )
         )
     elif op_type == OT.OP_REDUCTION:
@@ -142,10 +162,10 @@ def apply_parallel_op_shape(
 
 
 _INFO_PARAMS = {
-    OT.OP_REPARTITION: lambda i: RepartitionParams(i.dim, i.degree),
-    OT.OP_COMBINE: lambda i: CombineParams(i.dim, i.degree),
-    OT.OP_REPLICATE: lambda i: ReplicateParams(i.degree),
-    OT.OP_REDUCTION: lambda i: ReductionParams(i.degree),
+    OT.OP_REPARTITION: lambda i: RepartitionParams(i.dim, i.degree, i.axes),
+    OT.OP_COMBINE: lambda i: CombineParams(i.dim, i.degree, i.axes),
+    OT.OP_REPLICATE: lambda i: ReplicateParams(i.degree, i.axes),
+    OT.OP_REDUCTION: lambda i: ReductionParams(i.degree, i.axes),
 }
 
 
@@ -185,26 +205,43 @@ def derive_parallel_assignment(op_type: OT, params, in_assignment, mesh):
     degree and which the tensor doesn't already use — the analog of the
     mapper choosing fresh devices for a higher-degree machine view."""
     a = [list(x) for x in in_assignment]
+    declared = tuple(getattr(params, "axes", ()))
     if op_type == OT.OP_REPARTITION:
-        used = {ax for entry in a for ax in entry}
-        for name, size in mesh.shape.items():
-            if size == params.degree and name not in used:
-                a[params.dim].append(name)
-                break
+        if declared:
+            # the rewrite named its axes (MachineView binding): use them —
+            # but a mesh axis may shard a tensor at most once (same check
+            # as the inference path's "unused axis" scan)
+            used = {ax for entry in a for ax in entry}
+            dup = used.intersection(declared)
+            if dup or len(set(declared)) != len(declared):
+                raise ValueError(
+                    f"repartition(axes={declared}): axes already sharding "
+                    f"this tensor ({sorted(used)})")
+            a[params.dim].extend(declared)
         else:
-            raise ValueError(
-                f"repartition(degree={params.degree}): no unused mesh axis "
-                f"of that size in {dict(mesh.shape)}"
-            )
+            used = {ax for entry in a for ax in entry}
+            for name, size in mesh.shape.items():
+                if size == params.degree and name not in used:
+                    a[params.dim].append(name)
+                    break
+            else:
+                raise ValueError(
+                    f"repartition(degree={params.degree}): no unused mesh "
+                    f"axis of that size in {dict(mesh.shape)}"
+                )
     elif op_type == OT.OP_COMBINE:
-        removed = 1
-        while removed < params.degree and a[params.dim]:
-            removed *= mesh.shape[a[params.dim].pop()]
-        if removed != params.degree:
-            raise ValueError(
-                f"combine(degree={params.degree}) cannot unshard assignment "
-                f"{in_assignment[params.dim]} over {dict(mesh.shape)}"
-            )
+        if declared and a[params.dim][-len(declared):] == list(declared):
+            del a[params.dim][-len(declared):]
+        else:
+            removed = 1
+            while removed < params.degree and a[params.dim]:
+                removed *= mesh.shape[a[params.dim].pop()]
+            if removed != params.degree:
+                raise ValueError(
+                    f"combine(degree={params.degree}) cannot unshard "
+                    f"assignment {in_assignment[params.dim]} over "
+                    f"{dict(mesh.shape)}"
+                )
     elif op_type == OT.OP_FUSED_PARALLEL:
         cur = tuple(tuple(x) for x in a)
         for info in params.ops:

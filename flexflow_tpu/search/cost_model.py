@@ -143,20 +143,36 @@ def price_parallel_node(node, machine) -> tuple[float, tuple]:
         return fallback or AXIS_MODEL
 
     for st, sp in subs:
+        # rewrites thread the mesh axes they bind onto the params (the
+        # durable fix for degree→axis ambiguity: a declared axis is priced
+        # as itself, DCN or not); legacy degree-only params fall back to
+        # _degree_axis inference
+        declared = tuple(getattr(sp, "axes", ()))
         if st == OT.OP_COMBINE:
-            ax = _degree_axis(sp.degree)
-            comm += machine.all_gather(local_bytes * sp.degree, ax)
-            comm_axes.append(ax)
+            axes = declared or (_degree_axis(sp.degree),)
+            # multi-axis combine gathers axis by axis; the gathered shard
+            # grows by each axis's size before the next gather
+            grown = local_bytes
+            for ax in axes:
+                grown *= machine.axis_size(ax)
+                comm += machine.all_gather(grown, ax)
+                comm_axes.append(ax)
         elif st == OT.OP_REPARTITION:
             if pt.shape.total_degree > 1:
-                ax = _degree_axis(sp.degree)
-                comm += machine.all_to_all(local_bytes, ax)
-                comm_axes.append(ax)
+                axes = declared or (_degree_axis(sp.degree),)
+                # each split shrinks the shard the next all_to_all moves
+                # (mirror of the combine path, which grows it per gather)
+                shrink = local_bytes
+                for ax in axes:
+                    comm += machine.all_to_all(shrink, ax)
+                    comm_axes.append(ax)
+                    shrink /= max(1, machine.axis_size(ax))
             # from fully-replicated: local slice, free
         elif st == OT.OP_REDUCTION:
-            ax = _degree_axis(sp.degree)
-            comm += machine.all_reduce(local_bytes, ax)
-            comm_axes.append(ax)
+            axes = declared or (_degree_axis(sp.degree),)
+            for ax in axes:
+                comm += machine.all_reduce(local_bytes, ax)
+                comm_axes.append(ax)
         # Replicate: broadcast of an already-replicated tensor and Pipeline
         # stage markers are free
     return comm, tuple(comm_axes)

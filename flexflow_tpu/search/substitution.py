@@ -426,7 +426,8 @@ def propagate_parallel_state(graph: Graph):
                         and all(d.degree == 1
                                 for d in in_shapes[0].dims[1:])):
                     dims = [ParallelDim(shp[0],
-                                        in_shapes[0].dims[0].degree)]
+                                        in_shapes[0].dims[0].degree,
+                                        axes=in_shapes[0].dims[0].axes)]
                     dims += [ParallelDim(s) for s in shp[1:]]
                 elif all(d.degree == 1 for s in in_shapes for d in s.dims):
                     dims = [ParallelDim(s) for s in shp]
@@ -471,7 +472,7 @@ def _linear_parallel(node, in_shape: ParallelTensorShape, wp: dict):
     if r > 1:
         if out_ch % r != 0:
             raise ValueError(f"{node.name}: out_channels {out_ch} % {r} != 0")
-        out_dims.append(ParallelDim(out_ch, r))
+        out_dims.append(ParallelDim(out_ch, r, axes=replicas[0].axes))
         wp["kernel"] = (1, r)
         if node.params.use_bias:
             wp["bias"] = (0, r)
@@ -479,8 +480,8 @@ def _linear_parallel(node, in_shape: ParallelTensorShape, wp: dict):
         out_dims.append(ParallelDim(out_ch))
     if feat_deg > 1:
         wp["kernel"] = (0, feat_deg)
-        out_dims.append(ParallelDim(feat_deg, feat_deg,
-                                    is_replica_dim=True))
+        out_dims.append(ParallelDim(feat_deg, feat_deg, is_replica_dim=True,
+                                    axes=logical[-1].axes))
     return ParallelTensorShape(tuple(out_dims), in_shape.dtype)
 
 
@@ -515,7 +516,7 @@ def _conv_parallel(node, in_shape: ParallelTensorShape, wp: dict):
         if p.out_channels % r != 0:
             raise ValueError(
                 f"{node.name}: out_channels {p.out_channels} % {r} != 0")
-        out_dims.append(ParallelDim(p.out_channels, r))
+        out_dims.append(ParallelDim(p.out_channels, r, axes=replicas[0].axes))
         wp["kernel"] = (0, r)
         if p.use_bias:
             wp["bias"] = (0, r)
@@ -527,8 +528,8 @@ def _conv_parallel(node, in_shape: ParallelTensorShape, wp: dict):
             raise ValueError(
                 f"{node.name}: channel-sharded grouped conv unsupported")
         wp["kernel"] = (1, chan_deg)
-        out_dims.append(ParallelDim(chan_deg, chan_deg,
-                                    is_replica_dim=True))
+        out_dims.append(ParallelDim(chan_deg, chan_deg, is_replica_dim=True,
+                                    axes=logical[1].axes))
     return ParallelTensorShape(tuple(out_dims), in_shape.dtype)
 
 
@@ -560,7 +561,7 @@ def _embedding_parallel(node, in_shape: ParallelTensorShape, wp: dict):
         if p.out_channels % r != 0:
             raise ValueError(
                 f"{node.name}: out_channels {p.out_channels} % {r} != 0")
-        out_dims.append(ParallelDim(p.out_channels, r))
+        out_dims.append(ParallelDim(p.out_channels, r, axes=replicas[0].axes))
         wp["kernel"] = (1, r)
     else:
         out_dims.append(ParallelDim(p.out_channels))
@@ -591,7 +592,8 @@ def _attention_parallel(node, in_shapes, wp: dict):
         for b in ("bq", "bk", "bv"):
             wp[b] = (0, r)
         wp["wo"] = (0, r)
-        out_dims.append(ParallelDim(r, r, is_replica_dim=True))
+        out_dims.append(ParallelDim(r, r, is_replica_dim=True,
+                                    axes=replicas[0].axes))
     return ParallelTensorShape(tuple(out_dims), q.dtype)
 
 
@@ -599,34 +601,81 @@ def _attention_parallel(node, in_shapes, wp: dict):
 
 def assign_axes_from_degrees(graph: Graph, mesh):
     """Map every tensor's ParallelDim degrees to mesh axes and emit weight
-    PartitionSpecs — the FFMapper analog for rewritten graphs. Batch (dim-0)
-    degrees ride the `data` axis; feature/replica/reduction degrees ride
-    `model`. Unsharded tensors get the default data-parallel batch sharding
+    PartitionSpecs — the FFMapper analog for rewritten graphs. Dims whose
+    rewrite declared its mesh axes (ParallelDim.axes, threaded from the
+    parallel-op params) use them verbatim — including composite multi-axis
+    degrees; legacy degree-only dims fall back to size inference (batch
+    degrees ride `data`, feature/replica degrees ride `model`). Unsharded
+    tensors get the default data-parallel batch sharding
     (graph.cc:1939-1964 fallback)."""
     sizes = dict(mesh.shape)
     data_deg = sizes.get(AXIS_DATA, 1)
     model_deg = sizes.get(AXIS_MODEL, 1)
 
-    def axis_for(dim_idx: int, degree: int) -> str:
+    def axes_for(dim_idx: int, degree: int, axes=()) -> tuple:
+        if axes:
+            prod = 1
+            for a in axes:
+                prod *= sizes.get(a, 1)
+            if prod != degree:
+                raise ValueError(
+                    f"declared axes {axes} (product {prod}) do not carry "
+                    f"degree {degree} on mesh {sizes}")
+            return tuple(axes)
         if dim_idx == 0 and degree == data_deg:
-            return AXIS_DATA
+            return (AXIS_DATA,)
         if degree == model_deg:
-            return AXIS_MODEL
+            return (AXIS_MODEL,)
         if degree == data_deg:
-            return AXIS_DATA
+            return (AXIS_DATA,)
         raise ValueError(
             f"degree {degree} matches no mesh axis in {sizes}")
+
+    def wp_axes(node, degree) -> tuple:
+        # a weight partition's degree originates from the Replicate's
+        # replica dim (column TP) or a sharded NON-BATCH logical dim
+        # (row TP feature / conv channel). The batch dim can carry the
+        # same degree on different axes (dp×tp), so it must never source
+        # a weight partition's axes — match replica dims first, then
+        # non-batch logical dims only.
+        for pt in node.inputs:
+            for d in pt.shape.dims:
+                if d.is_replica_dim and d.degree == degree and d.axes:
+                    return d.axes
+        for pt in node.inputs:
+            logical_idx = -1
+            for d in pt.shape.dims:
+                if d.is_replica_dim:
+                    continue
+                logical_idx += 1
+                if logical_idx == 0:
+                    continue
+                if d.degree == degree and d.axes:
+                    return d.axes
+        return ()
 
     for node in graph.topo_order():
         for pt in node.outputs:
             assignment = []
+            used_axes: set = set()
             logical_idx = 0
             for d in pt.shape.dims:
                 if d.is_replica_dim:
                     assignment.append(())
                     continue
                 if d.degree > 1:
-                    assignment.append((axis_for(logical_idx, d.degree),))
+                    entry = axes_for(logical_idx, d.degree, d.axes)
+                    dup = used_axes.intersection(entry)
+                    if dup or len(set(entry)) != len(entry):
+                        # a mesh axis can shard at most one dim once — a
+                        # nested same-axis rewrite must be pruned at
+                        # costing, not handed to the executor
+                        raise ValueError(
+                            f"{node.name}: mesh axes used twice in one "
+                            f"tensor assignment ({entry}, already used "
+                            f"{sorted(used_axes)})")
+                    used_axes.update(entry)
+                    assignment.append(entry)
                 elif (logical_idx == 0 and data_deg > 1
                       and d.size % data_deg == 0
                       and not is_expert_buffer(node)):
@@ -645,7 +694,8 @@ def assign_axes_from_degrees(graph: Graph, mesh):
                 if ws is None:
                     continue
                 entries = [None] * len(ws.shape)
-                entries[dim_idx] = axis_for(-1, degree)
+                axes = axes_for(-1, degree, wp_axes(node, degree))
+                entries[dim_idx] = axes if len(axes) > 1 else axes[0]
                 node.weight_axes[wname] = PartitionSpec(*entries)
 
 
@@ -697,37 +747,48 @@ def _lin_act(act):
     return lambda n: n.params.activation == act
 
 
-def create_partition_linear_combine(degree: int, activation) -> GraphXfer:
+def _axes_tag(axes) -> str:
+    return f",axes={'x'.join(axes)}" if axes else ""
+
+
+def create_partition_linear_combine(degree: int, activation,
+                                    axes: tuple = ()) -> GraphXfer:
     """Repartition(sample) → Linear → Combine(sample)
-    (substitution.cc:3041)."""
-    x = GraphXfer(f"partition_linear_combine[deg={degree},act={activation}]")
+    (substitution.cc:3041). `axes` optionally binds the split to named
+    mesh axes (possibly composite, e.g. ('data', 'seq'))."""
+    axes = tuple(axes)
+    x = GraphXfer(f"partition_linear_combine[deg={degree},"
+                  f"act={activation}{_axes_tag(axes)}]")
     inp = x.new_input(0)
     lin1 = OpX(OT.OP_LINEAR, (inp,), constraints=(_lin_act(activation),))
     rep = OpX(OT.OP_REPARTITION, (inp,),
-              make_params=lambda m: RepartitionParams(0, degree))
+              make_params=lambda m: RepartitionParams(0, degree, axes))
     lin2 = OpX(OT.OP_LINEAR, (rep.outputs[0],), match_src=lin1)
     comb = OpX(OT.OP_COMBINE, (lin2.outputs[0],),
-               make_params=lambda m: CombineParams(0, degree))
+               make_params=lambda m: CombineParams(0, degree, axes))
     x.src_ops = [lin1]
     x.dst_ops = [rep, lin2, comb]
     x.map_output(lin1.outputs[0], comb.outputs[0])
     return x
 
 
-def create_replicate_linear_combine(degree: int, activation) -> GraphXfer:
+def create_replicate_linear_combine(degree: int, activation,
+                                    axes: tuple = ()) -> GraphXfer:
     """Replicate → Linear(kernel out-dim sharded) → Combine(feature): column
     tensor parallelism (substitution.cc:3226)."""
-    x = GraphXfer(f"replicate_linear_combine[deg={degree},act={activation}]")
+    axes = tuple(axes)
+    x = GraphXfer(f"replicate_linear_combine[deg={degree},"
+                  f"act={activation}{_axes_tag(axes)}]")
     inp = x.new_input(0)
     lin1 = OpX(OT.OP_LINEAR, (inp,), constraints=(_lin_act(activation),))
     repl = OpX(OT.OP_REPLICATE, (inp,),
-               make_params=lambda m: ReplicateParams(degree))
+               make_params=lambda m: ReplicateParams(degree, axes))
     lin2 = OpX(OT.OP_LINEAR, (repl.outputs[0],), match_src=lin1)
 
     def combine_feature(m):
         lin = m[lin1]
         ndim = len(lin.outputs[0].shape.logical_shape)
-        return CombineParams(ndim - 1, degree)
+        return CombineParams(ndim - 1, degree, axes)
 
     comb = OpX(OT.OP_COMBINE, (lin2.outputs[0],),
                make_params=combine_feature)
@@ -737,133 +798,150 @@ def create_replicate_linear_combine(degree: int, activation) -> GraphXfer:
     return x
 
 
-def create_replicate_attention_reduce(degree: int) -> GraphXfer:
+def create_replicate_attention_reduce(degree: int,
+                                      axes: tuple = ()) -> GraphXfer:
     """Replicate → MHA(heads sharded, row-parallel out-proj) → Reduction:
     inserts an explicit Reduction node consuming the partial-sum replica dim
     (substitution.cc create_replicate_attention_reduce)."""
-    x = GraphXfer(f"replicate_attention_reduce[deg={degree}]")
+    axes = tuple(axes)
+    x = GraphXfer(f"replicate_attention_reduce[deg={degree}"
+                  f"{_axes_tag(axes)}]")
     inp = x.new_input(0)
     attn1 = OpX(
         OT.OP_MULTIHEAD_ATTENTION, (inp, inp, inp),
         constraints=(lambda n: n.params.num_heads % degree == 0,),
     )
     repl = OpX(OT.OP_REPLICATE, (inp,),
-               make_params=lambda m: ReplicateParams(degree))
+               make_params=lambda m: ReplicateParams(degree, axes))
     r0 = repl.outputs[0]
     attn2 = OpX(OT.OP_MULTIHEAD_ATTENTION, (r0, r0, r0), match_src=attn1)
     red = OpX(OT.OP_REDUCTION, (attn2.outputs[0],),
-              make_params=lambda m: ReductionParams(degree))
+              make_params=lambda m: ReductionParams(degree, axes))
     x.src_ops = [attn1]
     x.dst_ops = [repl, attn2, red]
     x.map_output(attn1.outputs[0], red.outputs[0])
     return x
 
 
-def create_partition_attention_combine(degree: int) -> GraphXfer:
+def create_partition_attention_combine(degree: int,
+                                       axes: tuple = ()) -> GraphXfer:
     """Repartition(sample) → MHA → Combine(sample)
     (substitution.cc create_partition_attention_combine)."""
-    x = GraphXfer(f"partition_attention_combine[deg={degree}]")
+    axes = tuple(axes)
+    x = GraphXfer(f"partition_attention_combine[deg={degree}"
+                  f"{_axes_tag(axes)}]")
     inp = x.new_input(0)
     attn1 = OpX(OT.OP_MULTIHEAD_ATTENTION, (inp, inp, inp))
     rep = OpX(OT.OP_REPARTITION, (inp,),
-              make_params=lambda m: RepartitionParams(0, degree))
+              make_params=lambda m: RepartitionParams(0, degree, axes))
     r0 = rep.outputs[0]
     attn2 = OpX(OT.OP_MULTIHEAD_ATTENTION, (r0, r0, r0), match_src=attn1)
     comb = OpX(OT.OP_COMBINE, (attn2.outputs[0],),
-               make_params=lambda m: CombineParams(0, degree))
+               make_params=lambda m: CombineParams(0, degree, axes))
     x.src_ops = [attn1]
     x.dst_ops = [rep, attn2, comb]
     x.map_output(attn1.outputs[0], comb.outputs[0])
     return x
 
 
-def create_partition_add_combine(degree: int) -> GraphXfer:
+def create_partition_add_combine(degree: int, axes: tuple = ()) -> GraphXfer:
     """Repartition both addends on sample, add, Combine back
     (substitution.cc:3257)."""
-    x = GraphXfer(f"partition_add_combine[deg={degree}]")
+    axes = tuple(axes)
+    x = GraphXfer(f"partition_add_combine[deg={degree}{_axes_tag(axes)}]")
     a, b = x.new_input(0), x.new_input(1)
     add1 = OpX(OT.OP_EW_ADD, (a, b))
     rep1 = OpX(OT.OP_REPARTITION, (a,),
-               make_params=lambda m: RepartitionParams(0, degree))
+               make_params=lambda m: RepartitionParams(0, degree, axes))
     rep2 = OpX(OT.OP_REPARTITION, (b,),
-               make_params=lambda m: RepartitionParams(0, degree))
+               make_params=lambda m: RepartitionParams(0, degree, axes))
     add2 = OpX(OT.OP_EW_ADD, (rep1.outputs[0], rep2.outputs[0]))
     comb = OpX(OT.OP_COMBINE, (add2.outputs[0],),
-               make_params=lambda m: CombineParams(0, degree))
+               make_params=lambda m: CombineParams(0, degree, axes))
     x.src_ops = [add1]
     x.dst_ops = [rep1, rep2, add2, comb]
     x.map_output(add1.outputs[0], comb.outputs[0])
     return x
 
 
-def _passthrough_partition(op_type: OT, degree: int, tag: str) -> GraphXfer:
-    x = GraphXfer(f"partition_{tag}_combine[deg={degree}]")
+def _passthrough_partition(op_type: OT, degree: int, tag: str,
+                           axes: tuple = ()) -> GraphXfer:
+    axes = tuple(axes)
+    x = GraphXfer(f"partition_{tag}_combine[deg={degree}{_axes_tag(axes)}]")
     inp = x.new_input(0)
     op1 = OpX(op_type, (inp,))
     rep = OpX(OT.OP_REPARTITION, (inp,),
-              make_params=lambda m: RepartitionParams(0, degree))
+              make_params=lambda m: RepartitionParams(0, degree, axes))
     op2 = OpX(op_type, (rep.outputs[0],), match_src=op1)
     comb = OpX(OT.OP_COMBINE, (op2.outputs[0],),
-               make_params=lambda m: CombineParams(0, degree))
+               make_params=lambda m: CombineParams(0, degree, axes))
     x.src_ops = [op1]
     x.dst_ops = [rep, op2, comb]
     x.map_output(op1.outputs[0], comb.outputs[0])
     return x
 
 
-def create_partition_relu_combine(degree: int) -> GraphXfer:
-    return _passthrough_partition(OT.OP_RELU, degree, "relu")
+def create_partition_relu_combine(degree: int, axes: tuple = ()) -> GraphXfer:
+    return _passthrough_partition(OT.OP_RELU, degree, "relu", axes)
 
 
-def create_partition_softmax_combine(degree: int) -> GraphXfer:
-    return _passthrough_partition(OT.OP_SOFTMAX, degree, "softmax")
+def create_partition_softmax_combine(degree: int,
+                                     axes: tuple = ()) -> GraphXfer:
+    return _passthrough_partition(OT.OP_SOFTMAX, degree, "softmax", axes)
 
 
-def create_partition_conv2d_combine(degree: int) -> GraphXfer:
+def create_partition_conv2d_combine(degree: int,
+                                    axes: tuple = ()) -> GraphXfer:
     """Repartition(sample) → Conv2D → Combine(sample)
     (substitution.cc create_partition_conv2d_combine)."""
-    x = GraphXfer(f"partition_conv2d_combine[deg={degree}]")
+    axes = tuple(axes)
+    x = GraphXfer(f"partition_conv2d_combine[deg={degree}{_axes_tag(axes)}]")
     inp = x.new_input(0)
     c1 = OpX(OT.OP_CONV2D, (inp,))
     rep = OpX(OT.OP_REPARTITION, (inp,),
-              make_params=lambda m: RepartitionParams(0, degree))
+              make_params=lambda m: RepartitionParams(0, degree, axes))
     c2 = OpX(OT.OP_CONV2D, (rep.outputs[0],), match_src=c1)
     comb = OpX(OT.OP_COMBINE, (c2.outputs[0],),
-               make_params=lambda m: CombineParams(0, degree))
+               make_params=lambda m: CombineParams(0, degree, axes))
     x.src_ops = [c1]
     x.dst_ops = [rep, c2, comb]
     x.map_output(c1.outputs[0], comb.outputs[0])
     return x
 
 
-def create_replicate_conv2d_combine(degree: int) -> GraphXfer:
+def create_replicate_conv2d_combine(degree: int,
+                                    axes: tuple = ()) -> GraphXfer:
     """Replicate → Conv2D(out-channel-sharded kernel) → Combine(channel):
     the channel/attribute-parallel conv rewrite (substitution.cc
     create_partition_attention_combine's conv sibling)."""
-    x = GraphXfer(f"replicate_conv2d_combine[deg={degree}]")
+    axes = tuple(axes)
+    x = GraphXfer(f"replicate_conv2d_combine[deg={degree}{_axes_tag(axes)}]")
     inp = x.new_input(0)
     c1 = OpX(OT.OP_CONV2D, (inp,),
              constraints=(lambda n: n.params.out_channels % degree == 0,))
     repl = OpX(OT.OP_REPLICATE, (inp,),
-               make_params=lambda m: ReplicateParams(degree))
+               make_params=lambda m: ReplicateParams(degree, axes))
     c2 = OpX(OT.OP_CONV2D, (repl.outputs[0],), match_src=c1)
     comb = OpX(OT.OP_COMBINE, (c2.outputs[0],),
-               make_params=lambda m: CombineParams(1, degree))
+               make_params=lambda m: CombineParams(1, degree, axes))
     x.src_ops = [c1]
     x.dst_ops = [repl, c2, comb]
     x.map_output(c1.outputs[0], comb.outputs[0])
     return x
 
 
-def create_partition_pool2d_combine(degree: int) -> GraphXfer:
-    return _passthrough_partition(OT.OP_POOL2D, degree, "pool2d")
+def create_partition_pool2d_combine(degree: int,
+                                    axes: tuple = ()) -> GraphXfer:
+    return _passthrough_partition(OT.OP_POOL2D, degree, "pool2d", axes)
 
 
-def create_partition_concat_combine(degree: int) -> GraphXfer:
+def create_partition_concat_combine(degree: int,
+                                    axes: tuple = ()) -> GraphXfer:
     """Repartition both concat operands on sample, concat, Combine back —
     the 2-ary instance (substitution.cc create_partition_concat_combine;
     the reference generates per num_inputs too)."""
-    x = GraphXfer(f"partition_concat_combine[deg={degree}]")
+    axes = tuple(axes)
+    x = GraphXfer(f"partition_concat_combine[deg={degree}{_axes_tag(axes)}]")
     a, b = x.new_input(0), x.new_input(1)
     # arity constraint is load-bearing: the matcher only checks the node has
     # AT LEAST as many inputs as the pattern, so without it a 3-input
@@ -872,30 +950,33 @@ def create_partition_concat_combine(degree: int) -> GraphXfer:
                constraints=(lambda n: n.params.axis != 0,
                             lambda n: n.params.n == 2,))
     rep1 = OpX(OT.OP_REPARTITION, (a,),
-               make_params=lambda m: RepartitionParams(0, degree))
+               make_params=lambda m: RepartitionParams(0, degree, axes))
     rep2 = OpX(OT.OP_REPARTITION, (b,),
-               make_params=lambda m: RepartitionParams(0, degree))
+               make_params=lambda m: RepartitionParams(0, degree, axes))
     cat2 = OpX(OT.OP_CONCAT, (rep1.outputs[0], rep2.outputs[0]),
                match_src=cat1)
     comb = OpX(OT.OP_COMBINE, (cat2.outputs[0],),
-               make_params=lambda m: CombineParams(0, degree))
+               make_params=lambda m: CombineParams(0, degree, axes))
     x.src_ops = [cat1]
     x.dst_ops = [rep1, rep2, cat2, comb]
     x.map_output(cat1.outputs[0], comb.outputs[0])
     return x
 
 
-def create_partition_embedding_combine(degree: int) -> GraphXfer:
+def create_partition_embedding_combine(degree: int,
+                                       axes: tuple = ()) -> GraphXfer:
     """Repartition(sample) → Embedding → Combine(sample)
     (embedding.cc is partitionable on the sample dim)."""
-    x = GraphXfer(f"partition_embedding_combine[deg={degree}]")
+    axes = tuple(axes)
+    x = GraphXfer(f"partition_embedding_combine[deg={degree}"
+                  f"{_axes_tag(axes)}]")
     inp = x.new_input(0)
     e1 = OpX(OT.OP_EMBEDDING, (inp,))
     rep = OpX(OT.OP_REPARTITION, (inp,),
-              make_params=lambda m: RepartitionParams(0, degree))
+              make_params=lambda m: RepartitionParams(0, degree, axes))
     e2 = OpX(OT.OP_EMBEDDING, (rep.outputs[0],), match_src=e1)
     comb = OpX(OT.OP_COMBINE, (e2.outputs[0],),
-               make_params=lambda m: CombineParams(0, degree))
+               make_params=lambda m: CombineParams(0, degree, axes))
     x.src_ops = [e1]
     x.dst_ops = [rep, e2, comb]
     x.map_output(e1.outputs[0], comb.outputs[0])
@@ -979,33 +1060,37 @@ def create_linear_relu_merge() -> GraphXfer:
     return x
 
 
+def _axes_kw(kw):
+    return tuple(kw.get("axes", ()))
+
+
 _GENERATORS = {
     "partition_linear_combine":
         lambda deg, **kw: create_partition_linear_combine(
-            deg, kw.get("activation", ActiMode.AC_MODE_NONE)),
+            deg, kw.get("activation", ActiMode.AC_MODE_NONE), _axes_kw(kw)),
     "replicate_linear_combine":
         lambda deg, **kw: create_replicate_linear_combine(
-            deg, kw.get("activation", ActiMode.AC_MODE_NONE)),
+            deg, kw.get("activation", ActiMode.AC_MODE_NONE), _axes_kw(kw)),
     "replicate_attention_reduce":
-        lambda deg, **kw: create_replicate_attention_reduce(deg),
+        lambda deg, **kw: create_replicate_attention_reduce(deg, _axes_kw(kw)),
     "partition_attention_combine":
-        lambda deg, **kw: create_partition_attention_combine(deg),
+        lambda deg, **kw: create_partition_attention_combine(deg, _axes_kw(kw)),
     "partition_add_combine":
-        lambda deg, **kw: create_partition_add_combine(deg),
+        lambda deg, **kw: create_partition_add_combine(deg, _axes_kw(kw)),
     "partition_relu_combine":
-        lambda deg, **kw: create_partition_relu_combine(deg),
+        lambda deg, **kw: create_partition_relu_combine(deg, _axes_kw(kw)),
     "partition_softmax_combine":
-        lambda deg, **kw: create_partition_softmax_combine(deg),
+        lambda deg, **kw: create_partition_softmax_combine(deg, _axes_kw(kw)),
     "partition_conv2d_combine":
-        lambda deg, **kw: create_partition_conv2d_combine(deg),
+        lambda deg, **kw: create_partition_conv2d_combine(deg, _axes_kw(kw)),
     "replicate_conv2d_combine":
-        lambda deg, **kw: create_replicate_conv2d_combine(deg),
+        lambda deg, **kw: create_replicate_conv2d_combine(deg, _axes_kw(kw)),
     "partition_pool2d_combine":
-        lambda deg, **kw: create_partition_pool2d_combine(deg),
+        lambda deg, **kw: create_partition_pool2d_combine(deg, _axes_kw(kw)),
     "partition_concat_combine":
-        lambda deg, **kw: create_partition_concat_combine(deg),
+        lambda deg, **kw: create_partition_concat_combine(deg, _axes_kw(kw)),
     "partition_embedding_combine":
-        lambda deg, **kw: create_partition_embedding_combine(deg),
+        lambda deg, **kw: create_partition_embedding_combine(deg, _axes_kw(kw)),
     "linear_relu_merge": lambda deg, **kw: create_linear_relu_merge(),
     "fuse_moe_trio": lambda deg, **kw: create_fuse_moe_trio(
         int(kw.get("n", deg))),
@@ -1015,10 +1100,18 @@ _GENERATORS = {
 def generate_all_pcg_xfers(mesh, config, graph: Optional[Graph] = None
                            ) -> list[GraphXfer]:
     """The rule set for a mesh (generate_all_pcg_xfers,
-    substitution.cc:1726): one instance of each family per usable parallel
-    degree (mesh axis sizes play the role of workersPerNode divisors).
-    When the graph is given, data-driven families are added too (one
-    fuse_moe_trio per distinct Group_by expert count)."""
+    substitution.cc:1726-1868): one instance of each family per EXPRESSIBLE
+    parallel degree, where the mesh's single ICI axes and composite axis
+    pairs play the role of the reference's per-degree loops. On a TPU mesh
+    the expressible degrees are exactly products of whole named axes (GSPMD
+    shards a dim over whole axes); sub-axis degrees — a degree-2 split on an
+    8-wide axis — are reached by re-factorizing the mesh itself
+    (search/mesh_search.py), not by a rewrite. Each instance carries its
+    axes on the parallel-op params, so assignment and pricing never infer
+    an axis from a degree. When the graph is given, data-driven families
+    are added too (one fuse_moe_trio per distinct Group_by expert count)."""
+    from ..machine import AXIS_SEQ
+
     xfers: list[GraphXfer] = [create_linear_relu_merge()]
     if graph is not None:
         seen_n = set()
@@ -1027,26 +1120,54 @@ def generate_all_pcg_xfers(mesh, config, graph: Optional[Graph] = None
                 seen_n.add(node.params.n)
                 xfers.append(create_fuse_moe_trio(node.params.n))
     sizes = dict(mesh.shape)
-    model_deg = sizes.get(AXIS_MODEL, 1)
-    data_deg = sizes.get(AXIS_DATA, 1)
     acts = (ActiMode.AC_MODE_NONE, ActiMode.AC_MODE_RELU,
             ActiMode.AC_MODE_SIGMOID, ActiMode.AC_MODE_GELU)
-    if model_deg > 1:
+
+    def deg_of(axes) -> int:
+        d = 1
+        for a in axes:
+            d *= sizes[a]
+        return d
+
+    # batch-split (Repartition) axis groups: data, seq, and their
+    # composition; weight-split (Replicate/Reduction) groups: model, and
+    # model×seq. The seq axis doubles as extra batch/TP capacity when the
+    # graph doesn't need it for ring attention — the search arbitrates.
+    batch_groups = [(a,) for a in (AXIS_DATA, AXIS_SEQ)
+                    if sizes.get(a, 1) > 1]
+    if len(batch_groups) == 2:
+        batch_groups.append((AXIS_DATA, AXIS_SEQ))
+    tp_groups = [(AXIS_MODEL,)] if sizes.get(AXIS_MODEL, 1) > 1 else []
+    if tp_groups and sizes.get(AXIS_SEQ, 1) > 1:
+        tp_groups.append((AXIS_MODEL, AXIS_SEQ))
+
+    seen_names = {x.name for x in xfers}
+
+    def add(x: GraphXfer):
+        # names encode (family, degree, act, axes): the dedup bound on the
+        # candidate pool
+        if x.name not in seen_names:
+            seen_names.add(x.name)
+            xfers.append(x)
+
+    for axes in tp_groups:
+        deg = deg_of(axes)
         for act in acts:
-            xfers.append(create_replicate_linear_combine(model_deg, act))
-        xfers.append(create_replicate_attention_reduce(model_deg))
-        xfers.append(create_replicate_conv2d_combine(model_deg))
-    if data_deg > 1:
+            add(create_replicate_linear_combine(deg, act, axes))
+        add(create_replicate_attention_reduce(deg, axes))
+        add(create_replicate_conv2d_combine(deg, axes))
+    for axes in batch_groups:
+        deg = deg_of(axes)
         for act in acts:
-            xfers.append(create_partition_linear_combine(data_deg, act))
-        xfers.append(create_partition_attention_combine(data_deg))
-        xfers.append(create_partition_add_combine(data_deg))
-        xfers.append(create_partition_relu_combine(data_deg))
-        xfers.append(create_partition_softmax_combine(data_deg))
-        xfers.append(create_partition_conv2d_combine(data_deg))
-        xfers.append(create_partition_pool2d_combine(data_deg))
-        xfers.append(create_partition_concat_combine(data_deg))
-        xfers.append(create_partition_embedding_combine(data_deg))
+            add(create_partition_linear_combine(deg, act, axes))
+        add(create_partition_attention_combine(deg, axes))
+        add(create_partition_add_combine(deg, axes))
+        add(create_partition_relu_combine(deg, axes))
+        add(create_partition_softmax_combine(deg, axes))
+        add(create_partition_conv2d_combine(deg, axes))
+        add(create_partition_pool2d_combine(deg, axes))
+        add(create_partition_concat_combine(deg, axes))
+        add(create_partition_embedding_combine(deg, axes))
     return xfers
 
 

@@ -78,12 +78,18 @@ class ParallelDim:
     degree: number of shards along this dim
     parallel_idx: index into the op's machine-view dims (-1 if unsharded)
     is_replica_dim: true for dims that exist only to count replicas
+    axes: mesh axes this degree rides, when the rewrite that introduced it
+      declared them (the MachineView device-grid binding recast as named
+      mesh axes); empty = infer from degree (legacy). Threading the axes
+      removes the degree→axis ambiguity on meshes where several axes share
+      a size (a degree-2 Combine on a dcn=2, model=2 mesh).
     """
 
     size: int
     degree: int = 1
     parallel_idx: int = -1
     is_replica_dim: bool = False
+    axes: tuple = ()
 
     def __post_init__(self):
         if self.degree < 1:
@@ -150,7 +156,16 @@ class ParallelTensorShape:
         parts = []
         for d in self.dims:
             tag = "R" if d.is_replica_dim else ""
-            parts.append(f"{d.size}{tag}/{d.degree}" if d.degree > 1 or d.is_replica_dim else str(d.size))
+            if d.degree > 1 or d.is_replica_dim:
+                s = f"{d.size}{tag}/{d.degree}"
+                if d.axes:
+                    # axes are part of the cost surface (segment-cache keys
+                    # hash this repr) — two shapes differing only in which
+                    # mesh axis carries a degree price differently
+                    s += f"@{','.join(d.axes)}"
+                parts.append(s)
+            else:
+                parts.append(str(d.size))
         return f"PTShape[{' x '.join(parts)}, {self.dtype.name}]"
 
 

@@ -1,0 +1,252 @@
+"""Divisor-degree coverage: mesh factorization search + axis-threaded
+rewrites (reference substitution.cc:1726-1868 per-degree instantiation and
+the MachineView grid-shape enumeration, recast as: rewrites fire per mesh
+axis / composite axis group, and sub-axis degrees are reached by
+re-factorizing the mesh — search/mesh_search.py)."""
+
+import sys
+
+import pytest
+
+
+def _config(mesh_axes, batch=256, argv=()):
+    sys.argv = ["test"] + list(argv)
+    from flexflow_tpu import FFConfig
+
+    config = FFConfig()
+    config.mesh_axis_sizes = mesh_axes
+    config.batch_size = batch
+    return config
+
+
+def test_enumerate_factorizations():
+    from flexflow_tpu.search.mesh_search import enumerate_factorizations
+
+    shapes = enumerate_factorizations(8, ("data", "model"))
+    assert len(shapes) == 4
+    for s in shapes:
+        assert s["data"] * s["model"] == 8
+    assert {"data": 2, "model": 4} in shapes
+
+
+def test_mesh_search_finds_2x4_hybrid():
+    """The VERDICT acceptance case: on 8 devices, a pool-chain tower (only
+    batch-partitionable — no weights, channel dim 1) plus a weight-heavy
+    Linear (gradient-allreduce punishes wide DP; TP leaves the tower
+    unsharded). The 2×4 hybrid must beat BOTH 8-DP and 8-TP."""
+    from test_joint_search import _pcg_of
+
+    from flexflow_tpu import FFModel
+    from flexflow_tpu.search.machine_model import CHIPS
+    from flexflow_tpu.search.mesh_search import search_mesh_shapes
+
+    config = _config((8, 1, 1, 1),
+                     argv=["--budget", "4", "--enable-parameter-parallel"])
+    ff = FFModel(config)
+    x = ff.create_tensor((256, 1, 128, 128), name="x")
+    t = x
+    for i in range(3):
+        t = ff.pool2d(t, 2, 2, 1, 1, 0, 0, name=f"pool{i}")
+    t = ff.flat(t, name="flat")
+    t = ff.dense(t, 512, name="bigproj")
+
+    g = _pcg_of(ff)
+    shape, _, _, _, results = search_mesh_shapes(
+        g, 8, config, chip=CHIPS["v5e"])
+    costs = {(s["data"], s["model"]): c for s, c in results}
+    assert shape == {"data": 2, "model": 4}, costs
+    assert costs[(2, 4)] < costs[(8, 1)]
+    assert costs[(2, 4)] < costs[(1, 8)]
+
+
+def test_xfers_carry_axes_and_composites():
+    """Every parallel-op param created by generate_all_pcg_xfers names its
+    mesh axes, and composite (multi-axis) instances exist on a mesh with a
+    free seq axis."""
+    from flexflow_tpu.fftype import OperatorType as OT
+    from flexflow_tpu.search.mesh_search import MeshSpec
+    from flexflow_tpu.search.substitution import generate_all_pcg_xfers
+
+    config = _config((2, 2, 1, 2))
+    mesh = MeshSpec({"data": 2, "model": 2, "pipe": 1, "seq": 2})
+    xfers = generate_all_pcg_xfers(mesh, config)
+    names = {x.name for x in xfers}
+    assert any("axes=dataxseq" in n for n in names), sorted(names)
+    assert any("axes=modelxseq" in n for n in names), sorted(names)
+    # dedup: no duplicate names
+    assert len(names) == len(xfers)
+    # every parallel-op params constructor in dst patterns threads axes
+    # (constructors needing the match dict — e.g. the feature-dim Combine of
+    # replicate_linear_combine — are covered by the e2e search tests)
+    checked = 0
+    for x in xfers:
+        for opx in x.dst_ops:
+            if opx.op_type in (OT.OP_REPARTITION, OT.OP_COMBINE,
+                               OT.OP_REPLICATE, OT.OP_REDUCTION):
+                try:
+                    p = opx.make_params({})
+                except KeyError:
+                    continue
+                assert p.axes, f"{x.name}: {opx.op_type} missing axes"
+                checked += 1
+    assert checked > 10
+
+
+def test_assign_axes_uses_declared_composite():
+    """A degree-4 repartition declaring axes ('data','seq') must map to
+    those axes even when another mesh axis (model=4) shares the size — the
+    degree→axis ambiguity the threading removes."""
+    from flexflow_tpu.fftype import DataType, OperatorType as OT
+    from flexflow_tpu.parallel.ops import RepartitionParams
+    from flexflow_tpu.pcg.graph import Graph, OpNode
+    from flexflow_tpu.search.mesh_search import MeshSpec
+    from flexflow_tpu.search.substitution import (
+        assign_axes_from_degrees,
+        propagate_parallel_state,
+    )
+    from flexflow_tpu.tensor import ParallelTensor, ParallelTensorShape
+
+    g = Graph()
+    inp = OpNode(OT.OP_INPUT, None, name="x")
+    inp.outputs = [ParallelTensor(
+        ParallelTensorShape.from_shape((8, 16), DataType.DT_FLOAT))]
+    g.add_node(inp)
+    rep = OpNode(OT.OP_REPARTITION,
+                 RepartitionParams(0, 4, ("data", "seq")), name="rep")
+    g.add_node(rep)
+    g.add_edge(inp, rep, 0, 0)
+    propagate_parallel_state(g)
+    mesh = MeshSpec({"data": 2, "model": 4, "seq": 2})
+    assign_axes_from_degrees(g, mesh)
+    assert rep.outputs[0].axis_assignment[0] == ("data", "seq")
+
+
+def test_price_parallel_node_honors_declared_axes():
+    """A Combine that declares its axis is priced on THAT axis — a declared
+    dcn Combine prices at DCN bandwidth even though an ICI axis shares the
+    degree, and vice versa (the durable fix for degree-inference)."""
+    from flexflow_tpu.fftype import DataType, OperatorType as OT
+    from flexflow_tpu.parallel.ops import CombineParams
+    from flexflow_tpu.pcg.graph import OpNode
+    from flexflow_tpu.search.cost_model import price_parallel_node
+    from flexflow_tpu.search.machine_model import CHIPS, TPUMachineModel
+    from flexflow_tpu.tensor import (
+        ParallelDim,
+        ParallelTensor,
+        ParallelTensorShape,
+    )
+
+    machine = TPUMachineModel(CHIPS["v5p"], {"dcn": 2, "model": 2},
+                              axis_over_dcn=frozenset({"dcn"}))
+
+    def combine_cost(axes):
+        node = OpNode(OT.OP_COMBINE, CombineParams(0, 2, axes), name="c")
+        shape = ParallelTensorShape(
+            (ParallelDim(1024, 2, axes=axes), ParallelDim(1024)),
+            DataType.DT_FLOAT)
+        node.inputs = [ParallelTensor(shape)]
+        cost, comm_axes = price_parallel_node(node, machine)
+        return cost, comm_axes
+
+    dcn_cost, dcn_axes = combine_cost(("dcn",))
+    ici_cost, ici_axes = combine_cost(("model",))
+    assert dcn_axes == ("dcn",) and ici_axes == ("model",)
+    assert dcn_cost > 5 * ici_cost
+
+
+def _apply_first_match(g, xfer):
+    m = next(iter(xfer.find_matches(g)))
+    return xfer.apply(g, m)
+
+
+def test_weight_partition_axes_ignore_batch_dim():
+    """Nested dp×tp rewrites on a mesh where data and model share a size:
+    the column-TP kernel must shard over the REPLICA dim's axes ('model'),
+    never the batch dim's ('data') even though both carry the same
+    degree."""
+    from test_joint_search import _pcg_of
+
+    from flexflow_tpu import ActiMode, FFModel
+    from flexflow_tpu.fftype import ActiMode as AM
+    from flexflow_tpu.search.mesh_search import MeshSpec
+    from flexflow_tpu.search.substitution import (
+        assign_axes_from_degrees,
+        create_partition_linear_combine,
+        create_replicate_linear_combine,
+    )
+
+    config = _config((2, 2, 1, 1), batch=8)
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 16), name="x")
+    ff.dense(x, 16, ActiMode.AC_MODE_NONE, name="fc")
+    g = _pcg_of(ff)
+    g = _apply_first_match(
+        g, create_partition_linear_combine(2, AM.AC_MODE_NONE, ("data",)))
+    g = _apply_first_match(
+        g, create_replicate_linear_combine(2, AM.AC_MODE_NONE, ("model",)))
+    assign_axes_from_degrees(g, MeshSpec({"data": 2, "model": 2}))
+    lin = next(n for n in g.topo_order()
+               if n.op_type.name == "OP_LINEAR")
+    spec = lin.weight_axes["kernel"]
+    assert "model" in str(spec) and "data" not in str(spec), spec
+
+
+def test_nested_same_axis_partition_rejected():
+    """Applying the same axis-bound partition twice must be rejected at
+    costing (a mesh axis cannot shard one tensor twice) instead of
+    reaching the executor as PartitionSpec(('data','data'))."""
+    from test_joint_search import _pcg_of
+
+    from flexflow_tpu import ActiMode, FFModel
+    from flexflow_tpu.fftype import ActiMode as AM
+    from flexflow_tpu.search.mesh_search import MeshSpec
+    from flexflow_tpu.search.substitution import (
+        assign_axes_from_degrees,
+        create_partition_linear_combine,
+    )
+
+    config = _config((2, 1, 1, 1), batch=8)
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 16), name="x")
+    ff.dense(x, 16, ActiMode.AC_MODE_NONE, name="fc")
+    g = _pcg_of(ff)
+    xfer = create_partition_linear_combine(2, AM.AC_MODE_NONE, ("data",))
+    g = _apply_first_match(g, xfer)
+    g2 = _apply_first_match(g, xfer)
+    with pytest.raises(ValueError, match="used twice|already sharding"):
+        assign_axes_from_degrees(g2, MeshSpec({"data": 2}))
+
+
+def test_compile_with_mesh_shape_search_trains():
+    """--search-mesh-shapes end to end: compile re-factorizes the mesh and
+    the chosen plan trains."""
+    import numpy as np
+
+    from flexflow_tpu import (
+        ActiMode,
+        FFModel,
+        LossType,
+        MetricsType,
+        SGDOptimizer,
+    )
+
+    config = _config((8, 1, 1, 1), batch=64,
+                     argv=["--budget", "2", "--search-mesh-shapes",
+                           "--enable-parameter-parallel"])
+    ff = FFModel(config)
+    x = ff.create_tensor((64, 32))
+    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 10, name="head")
+    t = ff.softmax(t, name="sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    sizes = dict(ff.mesh.shape)
+    n = 1
+    for v in sizes.values():
+        n *= v
+    assert n == 8, sizes
+    rs = np.random.RandomState(0)
+    xs = rs.randn(128, 32).astype(np.float32)
+    ys = rs.randint(0, 10, (128, 1)).astype(np.int32)
+    ff.fit(xs, ys, epochs=1)
