@@ -32,5 +32,12 @@ from .layers import (
     concatenate,
     subtract,
 )
+from . import callbacks, datasets
+from .callbacks import (
+    Callback,
+    EpochVerifyMetrics,
+    LearningRateScheduler,
+    VerifyMetrics,
+)
 from .models import Model, Sequential
 from .optimizers import SGD, Adam

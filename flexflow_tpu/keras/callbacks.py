@@ -1,0 +1,101 @@
+"""Keras callbacks (reference python/flexflow/keras/callbacks.py:1-90).
+
+Same surface and semantics: `Callback` hook base, `LearningRateScheduler`
+(epoch → rate, applied via the optimizer's set_learning_rate),
+`VerifyMetrics` (train-end accuracy gate) and `EpochVerifyMetrics`
+(per-epoch gate with early stop). Wired into `Model.fit(callbacks=...)` —
+train and epoch hooks fire; an `on_epoch_end` returning truthy stops
+training (the reference's early-stop contract)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _gate_value(accuracy) -> float:
+    """A plain float is a fraction (this API's get_accuracy convention);
+    the reference's ModelAccuracy-style enums (anything with a .value)
+    carry percents."""
+    if hasattr(accuracy, "value"):
+        return float(accuracy.value) / 100.0
+    return float(accuracy)
+
+
+class Callback:
+    def __init__(self):
+        self.validation_data = None
+        self.params = None
+        self.model = None
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_batch_begin(self, batch, logs=None):
+        pass
+
+    def on_batch_end(self, batch, logs=None):
+        pass
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+
+class LearningRateScheduler(Callback):
+    """schedule(epoch) -> float, applied before each epoch."""
+
+    def __init__(self, schedule):
+        super().__init__()
+        self.schedule = schedule
+
+    def on_epoch_begin(self, epoch, logs=None):
+        if not hasattr(self.model.optimizer, "lr"):
+            raise ValueError('Optimizer must have a "lr" attribute.')
+        lr = self.schedule(epoch)
+        if not isinstance(lr, (float, np.float32, np.float64)):
+            raise ValueError(
+                'The output of the "schedule" function should be float.')
+        # through the FFModel so the jitted step's cached executable is
+        # invalidated (the rate is a trace-time constant)
+        self.model.ffmodel.set_learning_rate(lr)
+        print("set learning rate ", self.model.optimizer.lr)
+
+
+class VerifyMetrics(Callback):
+    """Assert the final train accuracy clears a gate (AE scripts' check)."""
+
+    def __init__(self, accuracy):
+        super().__init__()
+        self.accuracy = _gate_value(accuracy)
+
+    def on_train_end(self, logs=None):
+        got = self.model.ffmodel.get_perf_metrics().get_accuracy()
+        assert got >= self.accuracy, (
+            f"accuracy gate failed: {got:.4f} < {self.accuracy:.4f}")
+
+
+class EpochVerifyMetrics(Callback):
+    """Per-epoch accuracy gate; returning True from on_epoch_end stops
+    training early once the gate is cleared."""
+
+    def __init__(self, accuracy, early_stop: bool = True):
+        super().__init__()
+        self.accuracy = _gate_value(accuracy)
+        self.early_stop = early_stop
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.early_stop:
+            return False
+        got = self.model.ffmodel.get_perf_metrics().get_accuracy()
+        return got >= self.accuracy

@@ -42,6 +42,7 @@ class BaseModel:
         self.ffmodel: Optional[FFModel] = None
         self.ffconfig = FFConfig()
         self._output_tensor = None
+        self.optimizer = None  # the core optimizer, set by compile()
 
     # ---- provided by subclasses: producing KTensors in topological order
     def _topo_calls(self):
@@ -98,16 +99,41 @@ class BaseModel:
         ff.compile(optimizer=_optim.get(optimizer), loss_type=loss_type,
                    metrics=mtypes)
         self.ffmodel = ff
+        self.optimizer = ff.optimizer  # scheduler-settable (callbacks.py)
         return ff
 
     def fit(self, x, y, epochs=1, batch_size=-1, callbacks=None,
             shuffle=True):
+        """Reference base_model.py:198-376 semantics: train/epoch callback
+        hooks fire around the per-epoch FFModel.fit loop; an on_epoch_end
+        returning truthy stops training early (EpochVerifyMetrics)."""
         assert self.ffmodel is not None, "call compile() first"
         if isinstance(x, (list, tuple)):
             names = [t.name for t in self._input_ktensors()]
             x = dict(zip(names, x))
-        self.ffmodel.fit(x, np.asarray(y), epochs=epochs,
-                         batch_size=batch_size, shuffle=shuffle)
+        y = np.asarray(y)
+        callbacks = list(callbacks or [])
+        for cb in callbacks:
+            cb.set_model(self)
+            cb.set_params({"epochs": epochs, "batch_size": batch_size})
+            cb.on_train_begin()
+        for epoch in range(epochs):
+            # per-epoch metrics, like the reference's reset at epoch start
+            # (base_model.py:397): gates read THIS epoch's accuracy, not a
+            # running average over all epochs
+            self.ffmodel.reset_metrics()
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            self.ffmodel.fit(x, y, epochs=1, batch_size=batch_size,
+                             shuffle=shuffle)
+            # evaluate EVERY callback's hook before deciding to stop — a
+            # short-circuiting any() would starve callbacks after the
+            # first truthy one of their final-epoch hook
+            stops = [cb.on_epoch_end(epoch) for cb in callbacks]
+            if any(stops):
+                break
+        for cb in callbacks:
+            cb.on_train_end()
 
     def evaluate(self, x, y, batch_size=-1):
         assert self.ffmodel is not None

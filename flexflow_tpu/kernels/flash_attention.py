@@ -112,6 +112,7 @@ def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, *refs,
     scale: float, causal: bool, block_q: int, block_k: int, seq_k: int,
     causal_offset: int, save_lse: bool, nj: int,
+    i_dim: int = 1, j_dim: int = 2,
 ):
     even_k = seq_k % block_k == 0
     single_kv = nj == 1
@@ -122,8 +123,8 @@ def _flash_kernel(
         lse_ref = None
     if not single_kv:
         m_ref, l_ref, acc_ref = refs
-    i = pl.program_id(1)
-    j = pl.program_id(2)
+    i = pl.program_id(i_dim)
+    j = pl.program_id(j_dim)
 
     def step(masked: bool):
         q = q_ref[0]  # (block_q, d)
@@ -334,9 +335,10 @@ def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
     *, scale: float, causal: bool, block_q: int, block_k: int,
     seq_q: int, seq_k: int, causal_offset: int, nj: int,
+    i_dim: int = 1, j_dim: int = 2,
 ):
-    i = pl.program_id(1)
-    j = pl.program_id(2)
+    i = pl.program_id(i_dim)
+    j = pl.program_id(j_dim)
 
     @pl.when(j == 0)
     def _init():
@@ -375,9 +377,10 @@ def _bwd_dkv_kernel(
     dk_acc, dv_acc,
     *, scale: float, causal: bool, block_q: int, block_k: int,
     seq_q: int, seq_k: int, causal_offset: int, ni: int, nj: int,
+    i_dim: int = 2, j_dim: int = 1,
 ):
-    j = pl.program_id(1)  # kv block
-    i = pl.program_id(2)  # q block (innermost, sequential)
+    j = pl.program_id(j_dim)  # kv block
+    i = pl.program_id(i_dim)  # q block (innermost, sequential)
 
     @pl.when(i == 0)
     def _init():
@@ -576,6 +579,224 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# --------------------------------------------------------- packed layout
+# (b, s, h·dh) activations end to end: the qkv projection's natural output
+# layout. Heads are selected by BlockSpec lane-offset index maps — block
+# index h on the last (h·dh)-wide dim — so NO head transpose/relayout ever
+# touches HBM (PERF.md measured the (b,s,h,d)→(b,h,s,d) copies at ~0.8 ms
+# per flagship step). The kernel bodies are shared with the bhsd path; only
+# the grids ((b, h, qi, kj)) and index maps differ.
+
+
+def _flash_fwd_packed(q, k, v, num_heads, causal, scale,
+                      block_q, block_k, save_lse=True):
+    b, s_q, e = q.shape
+    s_k = k.shape[1]
+    h = num_heads
+    d = e // h
+    bq = min(block_q, s_q)
+    bk = min(block_k, s_k)
+    nj = pl.cdiv(s_k, bk)
+    grid = (b, h, pl.cdiv(s_q, bq), nj)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        seq_k=s_k, causal_offset=s_k - s_q, save_lse=save_lse, nj=nj,
+        i_dim=2, j_dim=3,
+    )
+    qspec = pl.BlockSpec((1, bq, d), lambda bi, hi, i, j: (bi, i, hi))
+    kspec = pl.BlockSpec((1, bk, d), lambda bi, hi, i, j: (bi, j, hi))
+    out_specs = [qspec]
+    out_shape = [jax.ShapeDtypeStruct((b, s_q, e), q.dtype)]
+    if save_lse:
+        # row stats stay in the (b·h, s, LANES) layout the shared kernel
+        # bodies index; the flat block row is computed from (bi, hi)
+        out_specs.append(pl.BlockSpec(
+            (1, bq, LSE_LANES), lambda bi, hi, i, j: (bi * h + hi, i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, s_q, LSE_LANES), jnp.float32))
+    scratch_shapes = []
+    if nj > 1:
+        scratch_shapes = [
+            pltpu.VMEM((bq,), jnp.float32),
+            pltpu.VMEM((bq,), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ]
+    res = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qspec, kspec, kspec],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch_shapes,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=jax.default_backend() != "tpu",
+        name="flash_attention_fwd_packed",
+    )(q, k, v)
+    if save_lse:
+        return res[0], res[1]
+    return res[0], None
+
+
+def _flash_bwd_packed(q, k, v, out, lse, g, num_heads, causal, scale,
+                      block_q, block_k):
+    b, s_q, e = q.shape
+    s_k = k.shape[1]
+    h = num_heads
+    d = e // h
+    bq = min(block_q, s_q)
+    bk = min(block_k, s_k)
+    # delta = rowsum(dO·O) per head: reduce dh inside each head, then a
+    # tiny (b, s, h) transpose — no (·, d)-sized relayout
+    delta = jnp.sum(
+        (g.astype(jnp.float32) * out.astype(jnp.float32))
+        .reshape(b, s_q, h, d),
+        axis=-1,
+    ).transpose(0, 2, 1).reshape(b * h, s_q)
+    delta = jnp.broadcast_to(delta[..., None], (b * h, s_q, LSE_LANES))
+    interpret = jax.default_backend() != "tpu"
+    ni = pl.cdiv(s_q, bq)
+    nj = pl.cdiv(s_k, bk)
+    common = dict(
+        scale=scale, causal=causal, block_q=bq, block_k=bk,
+        seq_q=s_q, seq_k=s_k, causal_offset=s_k - s_q,
+    )
+    if ni == 1 and nj == 1:
+        spec = pl.BlockSpec((1, s_q, d), lambda bi, hi: (bi, 0, hi))
+        kspec = pl.BlockSpec((1, s_k, d), lambda bi, hi: (bi, 0, hi))
+        rowspec = pl.BlockSpec((1, s_q, LSE_LANES),
+                               lambda bi, hi: (bi * h + hi, 0, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_single_tile_kernel, scale=scale, causal=causal,
+                block_q=s_q, block_k=s_k, seq_q=s_q, seq_k=s_k,
+                causal_offset=s_k - s_q,
+            ),
+            grid=(b, h),
+            in_specs=[spec, kspec, kspec, spec, rowspec, rowspec],
+            out_specs=[spec, kspec, kspec],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, s_q, e), q.dtype),
+                jax.ShapeDtypeStruct((b, s_k, e), k.dtype),
+                jax.ShapeDtypeStruct((b, s_k, e), v.dtype),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel"),
+            ),
+            interpret=interpret,
+            name="flash_attention_bwd_fused_packed",
+        )(q, k, v, g, lse, delta)
+        return dq, dk, dv
+    qspec = pl.BlockSpec((1, bq, d), lambda bi, hi, i, j: (bi, i, hi))
+    kspec = pl.BlockSpec((1, bk, d), lambda bi, hi, i, j: (bi, j, hi))
+    rowspec = pl.BlockSpec((1, bq, LSE_LANES),
+                           lambda bi, hi, i, j: (bi * h + hi, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, nj=nj, i_dim=2, j_dim=3, **common),
+        grid=(b, h, ni, nj),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, s_q, e), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+        name="flash_attention_bwd_dq_packed",
+    )(q, k, v, g, lse, delta)
+    # kv-grid kernels: block index maps take (b, h, kv_j, q_i)
+    qspec2 = pl.BlockSpec((1, bq, d), lambda bi, hi, j, i: (bi, i, hi))
+    kspec2 = pl.BlockSpec((1, bk, d), lambda bi, hi, j, i: (bi, j, hi))
+    rowspec2 = pl.BlockSpec((1, bq, LSE_LANES),
+                            lambda bi, hi, j, i: (bi * h + hi, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, ni=ni, nj=nj, i_dim=3, j_dim=2,
+                          **common),
+        grid=(b, h, nj, ni),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s_k, e), k.dtype),
+            jax.ShapeDtypeStruct((b, s_k, e), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+        name="flash_attention_bwd_dkv_packed",
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_packed(q, k, v, num_heads, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd_packed(q, k, v, num_heads, causal, scale,
+                               block_q, block_k, save_lse=False)
+    return out
+
+
+def _flash_packed_vjp_fwd(q, k, v, num_heads, causal, scale,
+                          block_q, block_k):
+    out, lse = _flash_fwd_packed(q, k, v, num_heads, causal, scale,
+                                 block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_packed_vjp_bwd(num_heads, causal, scale, block_q, block_k,
+                          res, g):
+    q, k, v, out, lse = res
+    return _flash_bwd_packed(q, k, v, out, lse, g, num_heads, causal,
+                             scale, block_q, block_k)
+
+
+_flash_packed.defvjp(_flash_packed_vjp_fwd, _flash_packed_vjp_bwd)
+
+
+def flash_attention_packed(
+    q, k, v, *, num_heads: int, causal: bool = False,
+    scale: float | None = None, block_q: int = 512, block_k: int = 512,
+):
+    """Fused attention on (batch, seq, heads·head_dim) activations — the
+    qkv projection's natural layout, so no head transpose is ever
+    materialized. Numerics identical to flash_attention on the transposed
+    layout (same kernel bodies). Shapes the kernel can't tile fall back to
+    the XLA path via an explicit (cheap at those sizes) transpose."""
+    b, s_q, e = q.shape
+    s_k = k.shape[1]
+    d = e // num_heads
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if e % num_heads != 0:
+        raise ValueError(f"embed dim {e} % heads {num_heads} != 0")
+    # Mosaic requires the LAST block dim be a multiple of 128 or the full
+    # array width (lowering.py _check_block_mappings; interpret mode — the
+    # CPU test path — doesn't enforce it, so the gate applies on TPU only)
+    # — head selection by lane offset therefore needs head_dim % 128 == 0
+    # on hardware. Narrower heads route through the transposed-layout
+    # kernel; its head relayout is the price of hd < 128 under this
+    # hardware generation's tiling rules.
+    lane_ok = (d % 128 == 0 or num_heads == 1
+               or jax.default_backend() != "tpu")
+    if s_q < 128 or s_k < 128 or (causal and s_q > s_k) or not lane_ok:
+        def split(t, s):
+            return t.reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
+
+        out = flash_attention(split(q, s_q), split(k, s_k), split(v, s_k),
+                              causal=causal, scale=scale,
+                              block_q=block_q, block_k=block_k)
+        return out.transpose(0, 2, 1, 3).reshape(b, s_q, e)
+    return _flash_packed(q, k, v, num_heads, causal, scale,
+                         block_q, block_k)
 
 
 def flash_attention(

@@ -31,6 +31,20 @@ from jax.experimental.pallas import tpu as pltpu
 _ROW_BLOCK = 256
 
 
+def _row_block(n: int, d: int) -> int:
+    """Row-block size capped so the kernels' f32 temporaries (~8 live
+    (rb, d) buffers in the backward) stay inside Mosaic's 16MB scoped
+    vmem: rb·d·4 ≤ 1MB keeps the worst case ≈8MB. The cap rounds DOWN to a
+    power of two so it still divides the power-of-two-ish row counts
+    transformers produce (a multiple-of-8 cap like 168 at d=1536 would
+    fail n % rb for every power-of-two n and silently disable the fusion).
+    d=1024 keeps the tuned rb=256; rb=256 at d=2048 overflowed scoped vmem
+    on v5e (caught by scripts/cost_model_fidelity.py)."""
+    cap = max(8, 262144 // max(1, d))
+    cap = 1 << (cap.bit_length() - 1)  # floor to a power of two
+    return min(_ROW_BLOCK, cap, n)
+
+
 def _fwd_kernel(x_ref, s_ref, b_ref, y_ref, *, eps: float):
     x = x_ref[...].astype(jnp.float32)  # (rb, d)
     mu = jnp.mean(x, axis=-1, keepdims=True)
@@ -65,7 +79,7 @@ def _bwd_kernel(x_ref, s_ref, dy_ref, dx_ref, ds_ref, db_ref, *, eps: float):
 
 def _call_fwd(x2, scale2, bias2, eps):
     n, d = x2.shape
-    rb = min(_ROW_BLOCK, n)
+    rb = _row_block(n, d)
     grid = (n // rb,)
     return pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
@@ -94,7 +108,7 @@ def _fused_ln_fwd(x2, scale, bias, eps):
 def _fused_ln_bwd(eps, res, dy):
     x2, scale = res
     n, d = x2.shape
-    rb = min(_ROW_BLOCK, n)
+    rb = _row_block(n, d)
     grid = (n // rb,)
     dx, ds_part, db_part = pl.pallas_call(
         functools.partial(_bwd_kernel, eps=eps),
@@ -134,11 +148,11 @@ def fused_layer_norm_or_none(x, scale, bias, axes, eps):
     n = 1
     for s in x.shape[:-1]:
         n *= s
-    # rows must divide into 8-sublane-aligned blocks: `n % min(_ROW_BLOCK, n)`
-    # alone is vacuous for n < _ROW_BLOCK (n % n == 0) and a 12-row or
-    # 100-row block would fail Mosaic's 8-sublane tiling on real TPU
-    # (interpret-mode CPU tests can't catch that)
-    rb = min(_ROW_BLOCK, n)
+    # rows must divide into 8-sublane-aligned blocks: `n % rb` alone is
+    # vacuous for n < rb (n % n == 0) and a 12-row or 100-row block would
+    # fail Mosaic's 8-sublane tiling on real TPU (interpret-mode CPU tests
+    # can't catch that)
+    rb = _row_block(n, d)
     if d % 128 != 0 or n < 8 or rb % 8 != 0 or n % rb != 0:
         return None
     y2 = _fused_ln(x.reshape(n, d), scale, bias, float(eps))

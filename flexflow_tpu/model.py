@@ -756,16 +756,23 @@ class FFModel:
                 from .machine import AXIS_SEQ, MeshShape
                 from .search.mesh_search import search_mesh_shapes
 
+                # a PIPE_BLOCKS stack makes the pipe axis searchable too:
+                # the dp-vs-pp decision is taken ACROSS factorizations
+                # (each candidate's costing matches its execution)
+                search_axes = (AXIS_DATA, AXIS_MODEL)
+                if any(n.op_type == OT.OP_PIPE_BLOCKS
+                       for n in g.topo_order()):
+                    search_axes = search_axes + (AXIS_PIPE,)
                 ms = self.config.mesh_shape()
                 fixed = {a: s for a, s in zip(ms.axis_names, ms.axis_sizes)
-                         if s > 1 and a not in (AXIS_DATA, AXIS_MODEL)}
+                         if s > 1 and a not in search_axes}
                 if fixed:
-                    # factorizing around a pinned dcn/seq/pipe axis is not
+                    # factorizing around a pinned dcn/seq axis is not
                     # modeled — refuse loudly rather than silently collapse
                     # the configured axes to 1
                     raise ValueError(
                         f"--search-mesh-shapes factorizes the chip count "
-                        f"over (data, model) on a single slice; drop the "
+                        f"over {search_axes} on a single slice; drop the "
                         f"flag or the extra mesh axes {sorted(fixed)}")
                 machine_factory = None
                 if self.config.machine_model_file:
@@ -778,7 +785,8 @@ class FFModel:
                         self.config.machine_model_file, mesh)
                 _calibrate()
                 shape, g, choice, us, _ = search_mesh_shapes(
-                    g, n_devices, self.config, chip=machine.chip,
+                    g, n_devices, self.config, axes=search_axes,
+                    chip=machine.chip,
                     num_hosts=self.config.num_nodes,
                     calibrated=cost_model,
                     machine_factory=machine_factory)
@@ -1064,6 +1072,19 @@ class FFModel:
 
     def reset_metrics(self):
         self._counters = self.metrics.zero_counters()
+
+    def set_learning_rate(self, lr: float):
+        """Change the optimizer's learning rate mid-training (the keras
+        LearningRateScheduler hook; reference optimizer.cc set_learning_rate
+        swaps the kernel constant the same way). The rate is a trace-time
+        constant of the fused train step, so the cached executable is
+        dropped — the next batch retraces with the new rate (one compile per
+        distinct rate, amortized over the epoch that scheduled it)."""
+        assert self._compiled, "call compile() before set_learning_rate()"
+        if float(lr) == float(self.optimizer.lr):
+            return
+        self.optimizer.set_learning_rate(lr)
+        self.executor._train_step = None
 
     def get_perf_metrics(self) -> PerfMetrics:
         return PerfMetrics(jax.device_get(self._counters), self.metrics)

@@ -104,19 +104,27 @@ def _mha_forward(p: MultiHeadAttentionParams, inputs, weights, state, ctx):
     q = proj(q_in, weights["wq"], weights.get("bq"))
     k = proj(k_in, weights["wk"], weights.get("bk"))
     v = proj(v_in, weights["wv"], weights.get("bv"))
+    scale = 1.0 / math.sqrt(hd)
+
+    if p.impl == "flash":
+        # packed layout: the kernel selects heads with lane-offset block
+        # index maps, so the projections' (b, s, H·hd) output feeds it
+        # directly — no (b,s,h,d)→(b,h,s,d) HBM relayout in fwd OR bwd
+        # (PERF.md measured those copies at ~0.8 ms per flagship step)
+        from ..kernels.flash_attention import flash_attention_packed
+
+        out = flash_attention_packed(q, k, v, num_heads=H, causal=p.causal,
+                                     scale=scale)
+        y = proj(out, weights["wo"], weights.get("bo"))
+        return [y], state
 
     def split_heads(x):
         b, s, _ = x.shape
         return x.reshape(b, s, H, hd).transpose(0, 2, 1, 3)
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    scale = 1.0 / math.sqrt(hd)
 
-    if p.impl == "flash":
-        from ..kernels.flash_attention import flash_attention
-
-        out = flash_attention(q, k, v, causal=p.causal, scale=scale)
-    elif p.impl == "ring":
+    if p.impl == "ring":
         from ..parallel.ring_attention import ring_attention
 
         out = ring_attention(q, k, v, causal=p.causal, scale=scale,

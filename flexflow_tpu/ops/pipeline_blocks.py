@@ -65,9 +65,9 @@ def _ln(x, scale, bias):
 
 def _make_block_fn(num_heads: int, causal: bool, attention_impl: str):
     if attention_impl == "flash":
-        from ..kernels.flash_attention import flash_attention as _attn
+        from ..kernels.flash_attention import flash_attention_packed
     elif attention_impl == "xla":
-        from .attention import sdpa_xla as _attn
+        from .attention import sdpa_xla
     else:
         raise ValueError(
             f"PipelineBlocks supports attention_impl 'xla' or 'flash', "
@@ -82,14 +82,21 @@ def _make_block_fn(num_heads: int, causal: bool, attention_impl: str):
         qkv = a @ w["wqkv"].astype(a.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
-        def heads(t):
-            b, s, _ = t.shape
-            return t.reshape(b, s, num_heads, hd).transpose(0, 2, 1, 3)
+        if attention_impl == "flash":
+            # packed layout: heads selected by the kernel's lane-offset
+            # index maps — no head transpose relayout
+            o = flash_attention_packed(q, k, v, num_heads=num_heads,
+                                       causal=causal,
+                                       scale=1.0 / math.sqrt(hd))
+        else:
+            def heads(t):
+                b, s, _ = t.shape
+                return t.reshape(b, s, num_heads, hd).transpose(0, 2, 1, 3)
 
-        o = _attn(heads(q), heads(k), heads(v), causal=causal,
-                  scale=1.0 / math.sqrt(hd))
-        b, _, s, _ = o.shape
-        o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+            o = sdpa_xla(heads(q), heads(k), heads(v), causal=causal,
+                         scale=1.0 / math.sqrt(hd))
+            b, _, s, _ = o.shape
+            o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
         x = x + o @ w["wo"].astype(o.dtype)
 
         m = _ln(x, w["ln2_scale"], w["ln2_bias"])

@@ -41,6 +41,16 @@ class Optimizer:
         """Per-iteration hook (reference Optimizer::next used by Adam to fold
         beta^t factors); stateless here since `step` is threaded in-jit."""
 
+    def set_learning_rate(self, lr: float):
+        """Change the learning rate (reference SGDOptimizer/AdamOptimizer
+        set_learning_rate — keras LearningRateScheduler's hook). The new
+        value takes effect at the next train-step (re)build: the rate is a
+        compile-time constant of the jitted step, so the executor drops its
+        cached executable when this changes (FFModel.set_learning_rate)."""
+        if not hasattr(self, "lr"):
+            raise ValueError('Optimizer must have a "lr" attribute.')
+        self.lr = float(lr)
+
 
 @dataclass
 class SGDOptimizer(Optimizer):
@@ -83,6 +93,16 @@ class AdamOptimizer(Optimizer):
     @property
     def num_slots(self) -> int:
         return 2  # m and v
+
+    @property
+    def lr(self) -> float:
+        """Keras-facing alias (reference AdamOptimizer exposes alpha as the
+        scheduler-settable rate)."""
+        return self.alpha
+
+    @lr.setter
+    def lr(self, value: float):
+        self.alpha = float(value)
 
     def init(self, params):
         return {

@@ -419,23 +419,76 @@ class CostModel:
         forward and backward kernels separately (linear.cc:792-925); here
         backward = (time of value+vjp w.r.t. every float operand incl.
         weights) − forward, so TP-vs-DP tradeoffs that hinge on backward
-        cost use a measured ratio instead of the 2× rule of thumb."""
+        cost use a measured ratio instead of the 2× rule of thumb.
+
+        RELAY-IMMUNE two-point methodology (established empirically against
+        the tunneled backend, scripts/debug_calibrate.py): timing separate
+        calls measures ~ms dispatch; closure-captured constants re-stage
+        through the tunnel per call (~100 ms for 12 MB); and
+        block_until_ready does not reliably synchronize — only a
+        device_get fetch does, which itself costs a large CONSTANT (~90 ms
+        here). So: ONE jitted lax.fori_loop executable with a DYNAMIC trip
+        count, synchronized by fetching its scalar result, timed at two
+        trip counts — the slope (t(n2)−t(n1))/(n2−n1) is the true per-rep
+        kernel time with every constant overhead cancelled. The loop body
+        feeds a carry-derived epsilon into the first float operand so XLA
+        can neither hoist the loop-invariant op nor DCE it; medians of 3
+        guard against jitter."""
+        import statistics
         import time
 
         import jax
         import jax.numpy as jnp
 
-        def _timed(jitted):
-            out = jitted(*example_args)
-            jax.block_until_ready(out)
-            t0 = time.perf_counter()
-            reps = 5
-            for _ in range(reps):
-                out = jitted(*example_args)
-            jax.block_until_ready(out)
-            return (time.perf_counter() - t0) / reps
+        dev_args = jax.device_put(example_args)
 
-        fwd_t = _timed(jax.jit(fn))
+        def _timed(f):
+            flat0, tree = jax.tree.flatten(dev_args)
+            fidx = next((i for i, leaf in enumerate(flat0)
+                         if jnp.issubdtype(jnp.asarray(leaf).dtype,
+                                           jnp.floating)), None)
+
+            @jax.jit
+            def loop(flat, n):
+                def body(_, carry):
+                    cur = list(flat)
+                    if fidx is not None:
+                        # dynamic, numerically-negligible perturbation:
+                        # defeats loop-invariant hoisting without changing
+                        # the op's cost
+                        cur[fidx] = cur[fidx] + (carry * 1e-30).astype(
+                            cur[fidx].dtype)
+                    out = f(*jax.tree.unflatten(tree, cur))
+                    # FULLY reduce EVERY output leaf: an unused leaf (e.g.
+                    # the dW of a multi-grad tuple) lets XLA DCE its
+                    # producer, and consuming a single element lets the
+                    # simplifier sink the slice INTO a producing dot —
+                    # measured on-chip: [0]-consumption reads a ~zero
+                    # slope while the full sum reads exactly the bytes
+                    # roofline. The sum fuses into the producer's epilogue
+                    # (no extra HBM pass), so it is both safe and free.
+                    upd = jnp.float32(0)
+                    for leaf in jax.tree.leaves(out):
+                        upd += jnp.sum(leaf).astype(jnp.float32)
+                    return carry + upd
+
+                return jax.lax.fori_loop(0, n, body, jnp.float32(0))
+
+            n1, n2 = 16, 272
+            float(jax.device_get(loop(flat0, jnp.int32(n1))))  # compile+warm
+
+            def t_of(n):
+                ts = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    float(jax.device_get(loop(flat0, jnp.int32(n))))
+                    ts.append(time.perf_counter() - t0)
+                return statistics.median(ts)
+
+            dt = (t_of(n2) - t_of(n1)) / (n2 - n1)
+            return max(dt, 1e-7)
+
+        fwd_t = _timed(fn)
         bwd_t = None
         diff_argnums = tuple(
             i for i, a in enumerate(example_args)
@@ -445,10 +498,15 @@ class CostModel:
         )
         if diff_argnums:
             def scalar_loss(*args):
-                return jnp.sum(fn(*args).astype(jnp.float32))
+                # squared loss, not a plain sum: a constant cotangent lets
+                # XLA collapse the dW matmul into a row-sum reduction and
+                # the "measured backward" reads near-zero; d(out²) = 2·out
+                # keeps the cotangent dense like a real training backward
+                return jnp.sum(jnp.square(fn(*args).astype(jnp.float32)))
 
             try:
-                g = jax.jit(jax.grad(scalar_loss, argnums=diff_argnums))
+                # _timed wraps the callable in its own jitted scan loop
+                g = jax.grad(scalar_loss, argnums=diff_argnums)
                 both_t = _timed(g)
                 # grad re-runs the forward; keep a sane floor when timing
                 # noise makes the subtraction go negative

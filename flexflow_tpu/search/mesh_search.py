@@ -98,6 +98,7 @@ def search_mesh_shapes(
 
     best = None
     results = []
+    skipped: list = []
     for sizes in enumerate_factorizations(n_devices, axes):
         mesh = MeshSpec(sizes)
         machine = (machine_factory(mesh) if machine_factory is not None
@@ -110,9 +111,12 @@ def search_mesh_shapes(
         g = clone_graph(graph)
         try:
             g, choice, us = joint_graph_optimize(g, mesh, config, cm)
-        except ValueError:
+        except ValueError as e:
             # a factorization the graph cannot shard onto (e.g. batch not
-            # divisible): skip it rather than abort the search
+            # divisible): skip it rather than abort the search — but keep
+            # the reason, so an every-candidate failure (a search bug, not
+            # an unshardable graph) surfaces with diagnostics
+            skipped.append((dict(sizes), str(e)))
             continue
         t, mem = us.evaluate(choice)
         cost = us._memory_penalized(t, mem)
@@ -120,8 +124,9 @@ def search_mesh_shapes(
         if best is None or cost < best[4]:
             best = (dict(sizes), g, choice, us, cost)
     if best is None:
+        detail = "; ".join(f"{s}: {r}" for s, r in skipped[:4])
         raise ValueError(
             f"no mesh factorization of {n_devices} devices over {axes} "
-            f"admits this graph")
+            f"admits this graph — per-candidate reasons: {detail}")
     shape, g, choice, us, _ = best
     return shape, g, choice, us, results

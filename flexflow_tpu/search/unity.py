@@ -121,6 +121,33 @@ class UnitySearch:
         dp = NodeConfig("dp", _dp_assign(ndim, batch_ok,
                                           batch_axes=self.batch_axes))
         out = [dp]
+        if node.op_type == OT.OP_PIPE_BLOCKS:
+            from ..machine import AXIS_PIPE
+
+            pipe_deg = self.axis_sizes.get(AXIS_PIPE, 1)
+            if pipe_deg > 1 and node.params.num_layers % pipe_deg != 0:
+                # the runtime pipelines whenever the mesh has a pipe axis
+                # (parallel/pipeline.py) and would reject this division at
+                # dispatch — fail the candidate at costing so the mesh
+                # factorization search prunes it instead of picking an
+                # unexecutable shape
+                raise ValueError(
+                    f"{node.name}: {node.params.num_layers} blocks do not "
+                    f"divide over pipe axis of size {pipe_deg}")
+            if pipe_deg > 1:
+                # pipeline parallelism over the pipe axis (EXCEEDS the
+                # reference, whose OP_PIPELINE is enum-only): stacked block
+                # weights shard their layer dim, the runtime executes the
+                # ppermute fill/drain schedule (parallel/pipeline.py). This
+                # is the ONLY config on a pipe-carrying mesh because the
+                # runtime pipelines exactly when the mesh has a pipe axis —
+                # costing anything else would diverge from execution. The
+                # dp-vs-pp decision is made where it is executable: across
+                # mesh factorizations (search/mesh_search.py).
+                ws = tuple((w.name, PartitionSpec(AXIS_PIPE))
+                           for w in node.weight_specs)
+                return [NodeConfig("pp", dp.out_assign, ws)]
+            return out
         if self.config.only_data_parallel or (
                 self.model_deg <= 1 and self.seq_deg <= 1):
             return out
@@ -336,10 +363,37 @@ class UnitySearch:
                 psum += 3.0 * hops * self.cm.machine.rotate(
                     local_bytes, AXIS_SEQ)
                 comm_axes = comm_axes + (AXIS_SEQ,)
+            compute_t = cm.forward_time + cm.backward_time
+            if (cfg.name == "pp"
+                    and node.op_type == OT.OP_PIPE_BLOCKS):
+                # fill/drain bubble + stage hand-off pricing for the
+                # ppermute pipeline (parallel/pipeline.py): the ideal
+                # per-chip compute T/(data·P) (already reflected in
+                # op_cost's sharded flops) stretches by (M+P−1)/M — this
+                # INCLUDES the placeholder compute every stage burns
+                # during fill/drain ticks (SPMD executes everywhere) —
+                # and each of the ~3·(M+P−1) fwd+bwd ticks hands one
+                # microbatch activation to the next stage over a neighbor
+                # ICI link.
+                from ..machine import AXIS_PIPE
+
+                p = node.params
+                P = self.axis_sizes.get(AXIS_PIPE, 1)
+                M = p.num_microbatches or 2 * P
+                compute_t *= (M + P - 1) / M
+                out_pt = node.outputs[0]
+                mb_bytes = (_shard_elems(
+                    tuple(d.size for d in out_pt.shape.dims
+                          if not d.is_replica_dim),
+                    cfg.out_assign, self.axis_sizes)
+                    * dtype_bytes(out_pt.dtype) / M)
+                psum += 3.0 * (M + P - 1) * self.cm.machine.ppermute(
+                    mb_bytes, AXIS_PIPE)
+                comm_axes = comm_axes + (AXIS_PIPE,)
             if not comm_axes and cm.sync_time > 0:
                 comm_axes = (AXIS_DATA,)  # gradient allreduce rides `data`
             acc.add(node.guid,
-                    cm.forward_time + cm.backward_time,
+                    compute_t,
                     cm.comm_time + reshard + psum,
                     comm_axes=comm_axes, sync=cm.sync_time)
             mem += cm.memory
@@ -362,9 +416,11 @@ class UnitySearch:
                 return _dp_assign(ndim, True, last_axes=(AXIS_MODEL,),
                                   batch_axes=self.batch_axes)
             return _dp_assign(ndim, True, batch_axes=self.batch_axes)
-        if cfg.name in ("dp", "tp_col", "tp_attn", "tp_conv", "ep"):
+        if cfg.name in ("dp", "tp_col", "tp_attn", "tp_conv", "ep", "pp"):
             # tp_conv included: an O-sharded kernel consumes the FULL input
-            # channels, so a chan-sharded producer pays a real all-gather
+            # channels, so a chan-sharded producer pays a real all-gather;
+            # pp consumes the plain batch-sharded activation (stage weights
+            # ride pipe, activations ride data)
             return _dp_assign(ndim, True, batch_axes=self.batch_axes)
         if cfg.name in ("feat", "chan", "sp") and len(cfg.out_assign) == ndim:
             # pass-through configs consume their own (sharded) layout

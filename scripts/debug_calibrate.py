@@ -1,0 +1,75 @@
+"""Micro-debug for CostModel.calibrate on the real chip: measure ONE known
+op (a 512→2048 Linear at batch 8·512 rows) three ways —
+  1. calibrate()'s scan-looped timing (what the fidelity harness uses),
+  2. a hand-rolled jitted lax.scan over the same op (ground truth
+     methodology, mirrors bench.py),
+  3. the analytic roofline —
+to localize where the composed calibrated prediction inflates."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    sys.argv = [sys.argv[0]]
+    from flexflow_tpu import ActiMode, FFConfig, FFModel
+    from flexflow_tpu.search.cost_model import CostModel, _op_harness
+    from flexflow_tpu.search.machine_model import machine_model_for_mesh
+
+    config = FFConfig()
+    config.batch_size = 8
+    ff = FFModel(config)
+    x = ff.create_tensor((4096, 512), name="x")
+    ff.dense(x, 2048, ActiMode.AC_MODE_NONE, name="fc")
+    sys.path.insert(0, "/root/repo/tests")
+    from test_joint_search import _pcg_of
+
+    g = _pcg_of(ff)
+    node = next(n for n in g.topo_order() if n.name == "fc")
+
+    mm = machine_model_for_mesh({"data": 1})
+    cm = CostModel(mm)
+    fn, args = _op_harness(node)
+
+    t0 = time.perf_counter()
+    fwd, bwd = cm.calibrate(node, fn, args)
+    print(f"calibrate: fwd={fwd*1e3:.4f} ms bwd={bwd*1e3:.4f} ms "
+          f"(wall incl. compiles {time.perf_counter()-t0:.1f}s)")
+
+    # ground truth: same op, explicit scan, input threaded through carry
+    w = jnp.asarray(np.random.RandomState(0).randn(512, 2048), jnp.float32)
+    b = jnp.zeros((2048,), jnp.float32)
+    xin = jnp.asarray(np.random.RandomState(1).randn(4096, 512), jnp.float32)
+
+    def body(carry, _):
+        y = (xin + carry * 1e-30) @ w + b
+        return carry + y.ravel()[0], None
+
+    @jax.jit
+    def loop():
+        s, _ = jax.lax.scan(body, jnp.float32(0), None, length=16)
+        return s
+
+    jax.block_until_ready(loop())
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(loop())
+        ts.append((time.perf_counter() - t0) / 16)
+    print(f"hand-rolled scan fwd: {sorted(ts)[1]*1e3:.4f} ms/rep")
+
+    flops = 2 * 4096 * 512 * 2048
+    print(f"roofline (mfu 0.4): {flops/0.4/mm.chip.peak_flops*1e3:.4f} ms; "
+          f"bytes bound: {(4096*512+512*2048+4096*2048)*4/mm.chip.hbm_bandwidth*1e3:.4f} ms")
+
+
+if __name__ == "__main__":
+    main()

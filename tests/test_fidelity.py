@@ -1,0 +1,44 @@
+"""Cost-model fidelity CI leg (CPU mesh; the real-chip battery is
+scripts/cost_model_fidelity.py → FIDELITY_r05.json). The search only needs
+RANKING fidelity to pick the right plan, so the assertion is rank
+correlation between composed predictions and measured step times; absolute
+CPU times are meaningless against the analytic cpu ChipSpec (XLA:CPU is
+not the modeled machine), which is exactly why the artifact's headline
+numbers come from the real chip."""
+
+
+def test_fidelity_rank_correlation_and_calibration():
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    from scripts.cost_model_fidelity import (
+        _lm,
+        _spearman,
+        run_fidelity,
+    )
+
+    configs = [
+        _lm("lm_h64_s32_b4", 64, 4, 2, 32, 4, "xla", vocab=256),
+        _lm("lm_h128_s64_b4", 128, 4, 2, 64, 4, "xla", vocab=256),
+        _lm("lm_h256_s64_b8", 256, 4, 4, 64, 8, "xla", vocab=256),
+    ]
+    rep = run_fidelity(configs, steps=3, calibrate_top_k=4)
+    # size-separated same-family configs: predicted ordering must match
+    # measured ordering exactly — ranking is what the search consumes
+    assert rep["spearman"] >= 0.99, rep
+    assert rep["spearman_calibrated"] >= 0.99, rep
+    # calibration ran and changed the composed prediction (its absolute
+    # accuracy is only meaningful on the real chip — the cpu ChipSpec is a
+    # placeholder and XLA:CPU step overhead dwarfs per-op kernel time; the
+    # error-shrink demonstration lives in the FIDELITY_r05.json artifact)
+    for row in rep["configs"]:
+        assert row["predicted_calibrated_ms"] > 0
+        assert (row["predicted_calibrated_ms"] != row["predicted_ms"]), row
+
+
+def test_spearman_helper():
+    from scripts.cost_model_fidelity import _spearman
+
+    assert _spearman([1, 2, 3], [10, 20, 30]) == 1.0
+    assert _spearman([1, 2, 3], [30, 20, 10]) == -1.0
+    assert _spearman([1, 1, 1], [1, 2, 3]) == 0.0

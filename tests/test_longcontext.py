@@ -1,0 +1,41 @@
+"""Long-context leg (SURVEY §5 — capability the reference lacks): ring
+attention over the seq axis and the flash kernel's online-softmax path must
+agree with the XLA reference at 4k sequence on the CPU mesh. The real-chip
+throughput leg is bench.py's seq-4096 secondary metric."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def _mesh_seq8():
+    devs = np.array(jax.devices()[:8]).reshape(1, 1, 8)
+    return Mesh(devs, ("data", "model", "seq"))
+
+
+def test_ring_vs_flash_vs_reference_seq4k():
+    from flexflow_tpu.kernels.flash_attention import (
+        _attn_reference,
+        flash_attention,
+    )
+    from flexflow_tpu.parallel.ring_attention import ring_attention
+
+    rs = np.random.RandomState(0)
+    b, h, s, d = 1, 1, 4096, 8
+    q = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    ref = np.asarray(_attn_reference(q, k, v, True, scale))
+    flash = np.asarray(flash_attention(q, k, v, causal=True, scale=scale,
+                                       block_q=512, block_k=512))
+    np.testing.assert_allclose(flash, ref, rtol=2e-4, atol=2e-4)
+
+    mesh = _mesh_seq8()
+    ring = np.asarray(jax.jit(
+        lambda q, k, v: ring_attention(q, k, v, causal=True, scale=scale,
+                                       mesh=mesh)
+    )(q, k, v))
+    np.testing.assert_allclose(ring, ref, rtol=2e-4, atol=2e-4)
