@@ -6,9 +6,11 @@ THROUGHPUT) with the reference model scale (hidden 1024, 16 heads, 12
 layers, seq 512 — TransformerConfig, transformer.cc:79-85) recast as the
 decoder-only LM, and adds the MFU accounting BASELINE.md targets.
 
-Prints ONE JSON line:
+Prints the primary JSON line
   {"metric": "transformer_lm_tokens_per_sec_per_chip", "value": N,
    "unit": "tokens/s", "vs_baseline": MFU / 0.35}
+**LAST** — the driver parses the LAST line as the number of record, so any
+secondary legs (the TPU seq-4096 long-context leg) print before it.
 (vs_baseline = fraction of the 35%-MFU north-star target, BASELINE.json.)
 """
 
@@ -155,34 +157,15 @@ def main():
         steps, warmup = 5, 1
 
     tokens_per_sec, mfu = _measure_lm(cfg, batch, steps, warmup, on_tpu)
-    if tokens_per_sec is None:
-        # a physically impossible reading must never become the number of
-        # record: emit null and fail so the driver records the fluke as a
-        # fluke instead of a result
-        print("bench: all retries read >100% MFU — backend measurement "
-              "fluke, result is NOT trustworthy", file=sys.stderr)
-        print(json.dumps({
-            "metric": "transformer_lm_tokens_per_sec_per_chip",
-            "value": None,
-            "unit": "tokens/s",
-            "vs_baseline": None,
-        }))
-        sys.exit(1)
-    # primary metric FIRST — the driver's number of record
-    print(json.dumps({
-        "metric": "transformer_lm_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 2),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.35, 4),
-    }))
-    sys.stdout.flush()
 
-    if on_tpu:
+    if on_tpu and tokens_per_sec is not None:
         # secondary LONG-CONTEXT leg (seq 4096, same model family): the
         # regime where flash's causal block-skipping and the online-softmax
         # path actually matter — quantifies the exceeds-reference
-        # long-context capability (SURVEY §5). Never allowed to poison the
-        # primary metric: failures only print to stderr.
+        # long-context capability (SURVEY §5). Printed BEFORE the primary
+        # line (the driver's number of record is the LAST line — r05's
+        # record was accidentally this leg, a phantom 41% regression);
+        # failures only print to stderr.
         try:
             lcfg = TransformerLMConfig(
                 vocab_size=32000, hidden_size=1024, num_heads=16,
@@ -203,6 +186,29 @@ def main():
                       file=sys.stderr)
         except Exception as e:  # pragma: no cover - defensive
             print(f"bench: long-context leg failed: {e}", file=sys.stderr)
+
+    if tokens_per_sec is None:
+        # a physically impossible reading must never become the number of
+        # record: emit null and fail so the driver records the fluke as a
+        # fluke instead of a result
+        print("bench: all retries read >100% MFU — backend measurement "
+              "fluke, result is NOT trustworthy", file=sys.stderr)
+        print(json.dumps({
+            "metric": "transformer_lm_tokens_per_sec_per_chip",
+            "value": None,
+            "unit": "tokens/s",
+            "vs_baseline": None,
+        }))
+        sys.exit(1)
+    # primary metric LAST — the driver parses the last line as the number
+    # of record
+    print(json.dumps({
+        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.35, 4),
+    }))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

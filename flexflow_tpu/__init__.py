@@ -31,6 +31,7 @@ from .machine import MachineResource, MachineView, MeshShape, build_mesh
 from .metrics import Metrics, PerfMetrics
 from .model import FFModel
 from . import parallel  # registers parallel-op OpDefs
+from . import resilience  # checkpointing / elastic resume / preemption
 from .parallel import Strategy
 from .optimizer import AdamOptimizer, Optimizer, SGDOptimizer
 from .tensor import ParallelDim, ParallelTensor, ParallelTensorShape, Tensor

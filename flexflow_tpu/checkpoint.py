@@ -1,68 +1,50 @@
-"""Checkpoint / resume.
+"""DEPRECATED compat wrapper over the resilience subsystem.
 
-The reference has **no model checkpointing subsystem** (SURVEY §5: weights
-only via set_tensor/get_tensor). This module exceeds the reference with real
-sharded checkpointing via orbax: the full training state {params,
-op state, optimizer slots, step, metric counters} saves/restores with each
-array's NamedSharding preserved, so resume works on the same mesh layout
-without gathering to host.
+The original module was a blocking orbax wrapper with two defects this
+shim's replacement fixes (resilience/):
+
+- saves were not atomic: a kill mid-save corrupted the target path. The
+  resilience checkpointer serializes into a tmp dir and commits via a
+  single atomic rename, so a killed save never touches the latest-good
+  checkpoint.
+- restore built its template as `ffmodel._state or {}`, silently dropping
+  restored op state whenever the compiled model's `_state` was falsy; the
+  resilience restore path instead raises on any template/checkpoint leaf
+  mismatch.
+- restore required the *identical* mesh layout; the resilience path
+  reshards every leaf onto the target compile's NamedSharding, so a
+  checkpoint saved under dp=8 resumes under dp=4×tp=2.
+
+Use `FFModel.save_checkpoint/load_checkpoint`, `FFModel.enable_checkpointing`
+or `flexflow_tpu.resilience` directly; these wrappers remain for callers of
+the old module-level API. NOTE the on-disk format changed with the
+resilience subsystem (step_*/manifest.json + arrays.npz instead of an orbax
+tree): checkpoints written by the old orbax path are not readable — restore
+them with the release that wrote them and re-save.
 """
 
 from __future__ import annotations
 
-import os
+import warnings
 from typing import Optional
-
-import jax
-import numpy as np
 
 
 def save_checkpoint(ffmodel, path: str, step: Optional[int] = None):
-    """Save the full training state under `path` (orbax PyTreeCheckpointer)."""
-    import orbax.checkpoint as ocp
-
-    path = os.path.abspath(path)
-    state = {
-        "params": ffmodel._params,
-        "state": ffmodel._state or {},
-        "opt_slots": ffmodel._opt_slots,
-        "step": ffmodel._step,
-        "counters": ffmodel._counters,
-    }
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, state, force=True)
-    return path
+    """Deprecated: use FFModel.save_checkpoint (atomic, resilience-backed).
+    Saves the full training state as a committed checkpoint under root
+    `path`; returns the committed checkpoint directory."""
+    warnings.warn(
+        "flexflow_tpu.checkpoint.save_checkpoint is deprecated; use "
+        "FFModel.save_checkpoint or flexflow_tpu.resilience",
+        DeprecationWarning, stacklevel=2)
+    return ffmodel.save_checkpoint(path)
 
 
 def restore_checkpoint(ffmodel, path: str):
-    """Restore state saved by save_checkpoint into a compiled FFModel (must
-    be compiled with the same architecture + mesh)."""
-    import orbax.checkpoint as ocp
-
-    path = os.path.abspath(path)
-    ckptr = ocp.PyTreeCheckpointer()
-    template = {
-        "params": ffmodel._params,
-        "state": ffmodel._state or {},
-        "opt_slots": ffmodel._opt_slots,
-        "step": ffmodel._step,
-        "counters": ffmodel._counters,
-    }
-    restored = ckptr.restore(path, item=template)
-    # re-place leaves with the compiled model's shardings
-    def place(new, old):
-        sharding = getattr(old, "sharding", None)
-        arr = jax.numpy.asarray(new, getattr(old, "dtype", None))
-        return jax.device_put(arr, sharding) if sharding is not None else arr
-
-    ffmodel._params = jax.tree.map(place, restored["params"],
-                                   ffmodel._params)
-    if ffmodel._state:
-        ffmodel._state = jax.tree.map(place, restored["state"],
-                                      ffmodel._state)
-    ffmodel._opt_slots = jax.tree.map(place, restored["opt_slots"],
-                                      ffmodel._opt_slots)
-    ffmodel._step = jax.tree.map(place, restored["step"], ffmodel._step)
-    ffmodel._counters = jax.tree.map(place, restored["counters"],
-                                     ffmodel._counters)
-    return ffmodel
+    """Deprecated: use FFModel.load_checkpoint (reshard-aware — the saving
+    mesh may differ from this model's)."""
+    warnings.warn(
+        "flexflow_tpu.checkpoint.restore_checkpoint is deprecated; use "
+        "FFModel.load_checkpoint or flexflow_tpu.resilience",
+        DeprecationWarning, stacklevel=2)
+    return ffmodel.load_checkpoint(path)

@@ -102,6 +102,15 @@ class FFConfig:
     mesh_axis_sizes: Optional[tuple[int, ...]] = None  # (data, model, pipe, seq)
     mesh_axis_names: tuple[str, ...] = DEFAULT_AXES
     seed: int = 0
+    # resilience (resilience/): async checkpointing + preemption-safe fit.
+    # checkpoint_dir enables the subsystem; every-N-steps / every-T-seconds
+    # gate the async saves; auto_resume restores the newest committed
+    # checkpoint (resharding onto this run's mesh) before training.
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0
+    checkpoint_every_seconds: float = 0.0
+    checkpoint_keep: int = 3
+    auto_resume: bool = False
 
     def __post_init__(self):
         argv = sys.argv[1:]
@@ -261,6 +270,16 @@ class FFConfig:
                 self.mesh_axis_sizes = tuple(int(x) for x in val().split(","))
             elif a == "--seed":
                 self.seed = int(val())
+            elif a == "--checkpoint-dir":
+                self.checkpoint_dir = val()
+            elif a == "--checkpoint-every":
+                self.checkpoint_every = int(val())
+            elif a == "--checkpoint-every-seconds":
+                self.checkpoint_every_seconds = float(val())
+            elif a == "--checkpoint-keep":
+                self.checkpoint_keep = int(val())
+            elif a == "--auto-resume":
+                self.auto_resume = True
             elif a == "--synthetic-input":
                 self.synthetic_input = True
             elif a == "--allow-tensor-op-math-conversion":

@@ -35,6 +35,20 @@ class SingleDataLoader:
     def reset(self):
         self.next_index = 0
 
+    # ---- resumable cursor (resilience/): a checkpointed run restores the
+    # loader mid-epoch and the next batch is exactly the one the killed run
+    # would have issued
+    def state_dict(self) -> dict:
+        return {"next_index": int(self.next_index)}
+
+    def load_state_dict(self, state: dict):
+        idx = int(state["next_index"])
+        if idx < 0 or idx > self.num_samples:
+            raise ValueError(
+                f"dataloader cursor {idx} out of range for "
+                f"{self.num_samples} samples")
+        self.next_index = idx
+
     def next_batch(self, ffmodel=None) -> np.ndarray:
         if self.next_index + self.batch_size > self.num_samples:
             self.next_index = 0

@@ -54,6 +54,20 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+def barrier(name: str = "barrier"):
+    """Fleet-wide synchronization point (no-op single-process). The
+    resilience subsystem brackets its checkpoint commit with this: every
+    process must finish serializing before host 0 renames the tmp dir (a
+    commit racing a still-writing process would publish a torn snapshot),
+    and no process may move on believing the checkpoint durable before the
+    rename happened."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
 _ERR_KEY = "__broadcast_error__"
 
 

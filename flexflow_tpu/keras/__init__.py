@@ -37,6 +37,7 @@ from .callbacks import (
     Callback,
     EpochVerifyMetrics,
     LearningRateScheduler,
+    ModelCheckpoint,
     VerifyMetrics,
 )
 from .models import Model, Sequential

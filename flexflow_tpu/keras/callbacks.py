@@ -72,6 +72,85 @@ class LearningRateScheduler(Callback):
         print("set learning rate ", self.model.optimizer.lr)
 
 
+class ModelCheckpoint(Callback):
+    """Checkpoint during keras-style training, backed by the resilience
+    subsystem (atomic commits, reshard-aware restore — resilience/).
+
+    - periodic: every `every_n_epochs` epochs (default 1);
+    - save-best-on-metric: with save_best_only=True, only epochs improving
+      the monitored metric are saved. monitor="accuracy" (mode max, from
+      PerfMetrics.get_accuracy) or "loss" (mode min, the mean monitored
+      loss from the perf counters).
+
+    Restore with `model.ffmodel.load_checkpoint(directory)` — onto any
+    mesh/Strategy.
+    """
+
+    def __init__(self, directory: str, monitor: str = "accuracy",
+                 save_best_only: bool = False, every_n_epochs: int = 1,
+                 keep: int = 3, verbose: bool = False):
+        super().__init__()
+        if monitor not in ("accuracy", "loss"):
+            raise ValueError(
+                f"monitor must be 'accuracy' or 'loss', got {monitor!r}")
+        if every_n_epochs < 1:
+            raise ValueError("every_n_epochs must be >= 1")
+        self.directory = directory
+        self.monitor = monitor
+        self.save_best_only = save_best_only
+        self.every_n_epochs = every_n_epochs
+        self.keep = keep
+        self.verbose = verbose
+        self.best = None
+        self.last_saved = None
+        self._manager = None
+
+    def _metric(self) -> float:
+        pm = self.model.ffmodel.get_perf_metrics()
+        if self.monitor == "accuracy":
+            return float(pm.get_accuracy())
+        return float(pm.get_mean_loss())
+
+    def _improved(self, value: float) -> bool:
+        if self.best is None:
+            return True
+        return (value > self.best if self.monitor == "accuracy"
+                else value < self.best)
+
+    def on_train_begin(self, logs=None):
+        from ..resilience import ResilienceManager
+
+        ff = self.model.ffmodel
+        assert ff is not None, "compile() before fit with ModelCheckpoint"
+        self._manager = ResilienceManager(ff, self.directory, keep=self.keep)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (epoch + 1) % self.every_n_epochs != 0:
+            return False
+        value = self._metric()
+        if self.save_best_only and not self._improved(value):
+            return False
+        if self._improved(value):
+            self.best = value
+        ff = self.model.ffmodel
+        step = ff._py_step()
+        # cursor epochs are ABSOLUTE since compile (fit's convention):
+        # the inner fit already advanced _epoch_base past this epoch, and
+        # the keras-relative `epoch` restarts at 0 on a second fit call
+        abs_epoch = int(getattr(ff, "_epoch_base", epoch + 1))
+        # async: serialization overlaps the next epoch; commit is atomic
+        self._manager.save(step, cursor={"epoch": abs_epoch, "batch": 0})
+        self.last_saved = step
+        if self.verbose:
+            print(f"ModelCheckpoint: saved step {step} "
+                  f"({self.monitor}={value:.4f})")
+        return False  # never early-stop training
+
+    def on_train_end(self, logs=None):
+        if self._manager is not None:
+            self._manager.finalize()  # drain the in-flight async save
+
+
 class VerifyMetrics(Callback):
     """Assert the final train accuracy clears a gate (AE scripts' check)."""
 

@@ -109,6 +109,11 @@ class FFModel:
         self._grads = None
         self._compiled = False
         self._strategy = None  # node name -> dict of spec overrides
+        self._resilience = None  # ResilienceManager (resilience/manager.py)
+        self._fault_hook = None  # step -> None; test-only failure injection
+        self._epoch_base = 0  # absolute epochs completed across fit() calls
+        self._auto_resumed = False  # auto-resume fires at most once
+        self._resume_cursor = None  # (absolute epoch, batch) to resume at
 
     # ================================================== tensor creation
 
@@ -940,9 +945,55 @@ class FFModel:
         )
         return xs, y
 
+    def enable_checkpointing(self, directory: str, every_n_steps: int = 0,
+                             every_t_seconds: float = 0.0, keep: int = 3):
+        """Attach the resilience subsystem (resilience/): async snapshots
+        every N steps / T seconds during fit, SIGTERM-drains to a final
+        snapshot, and `auto_resume`-able committed checkpoints. The
+        programmatic twin of --checkpoint-dir/--checkpoint-every."""
+        from .resilience import CheckpointPolicy, ResilienceManager
+
+        self._resilience = ResilienceManager(
+            self, directory,
+            CheckpointPolicy(every_n_steps=every_n_steps,
+                             every_t_seconds=every_t_seconds),
+            keep=keep)
+        return self._resilience
+
+    def _py_step(self) -> int:
+        """The device step counter as a host int — THE checkpoint step
+        numbering convention (fit's policy decisions, explicit saves, and
+        the keras ModelCheckpoint all go through here)."""
+        return int(np.asarray(jax.device_get(self._step)))
+
+    def set_fault_hook(self, hook):
+        """Install a per-step failure-injection hook (resilience/fault.py):
+        called with the global step after each optimizer step + checkpoint
+        decision; raising simulates mid-fit death. Test-only."""
+        self._fault_hook = hook
+
+    def _epoch_order(self, num_samples: int, epoch: int,
+                     shuffle: bool) -> np.ndarray:
+        """Sample order for one epoch. Shuffles are keyed on (config.seed,
+        absolute epoch) — NOT the global numpy RNG — so a preempted run
+        that resumes mid-epoch replays the exact order the uninterrupted
+        run saw, making resume bit-exact. The absolute index includes
+        `_epoch_base` (epochs completed by previous fit() calls), so
+        repeated fit(epochs=1) calls — the keras per-epoch loop — get a
+        fresh order every epoch instead of re-training one fixed order."""
+        if not shuffle:
+            return np.arange(num_samples)
+        rs = np.random.RandomState(
+            (self.config.seed * 1_000_003
+             + self._epoch_base + epoch) % (2 ** 32))
+        return rs.permutation(num_samples)
+
     def fit(self, x: Union[np.ndarray, Sequence[np.ndarray], dict], y: np.ndarray,
             epochs: int = -1, batch_size: int = -1, shuffle: bool = True):
-        """Training loop (parity: flexflow_cffi.py:2058-2100)."""
+        """Training loop (parity: flexflow_cffi.py:2058-2100), made
+        preemption-safe: policy-gated async checkpoints between steps, a
+        SIGTERM drain-and-final-snapshot path, and --auto-resume restart
+        from the newest committed checkpoint's (epoch, batch) cursor."""
         assert self._compiled, "call compile() before fit()"
         if self.config.profiling and not getattr(self, "_profiled", False):
             # --profiling: per-op kernel table, printed once per compile
@@ -961,33 +1012,138 @@ class FFModel:
         num_batches = num_samples // batch_size
         step_fn = self.executor._train_step or self.executor.build_train_step()
 
-        for epoch in range(epochs):
-            order = np.random.permutation(num_samples) if shuffle else np.arange(num_samples)
-            t0 = time.time()
-            for b in range(num_batches):
-                idx = order[b * batch_size : (b + 1) * batch_size]
-                xb = {k: v[idx] for k, v in x_dict.items()}
-                yb = y[idx]
-                batch = self._make_batch(xb, yb)
-                self._rng, sub = jax.random.split(self._rng)
-                (
-                    self._params,
-                    self._state,
-                    self._opt_slots,
-                    self._step,
-                    self._counters,
-                    lval,
-                ) = step_fn(
-                    self._params, self._state, self._opt_slots, self._step,
-                    self._counters, sub, batch,
-                )
-            jax.block_until_ready(self._params)
-            dt = time.time() - t0
-            thru = num_batches * batch_size / dt
-            print(
-                f"epoch {epoch}: {self.get_perf_metrics()} "
-                f"ELAPSED TIME = {dt:.4f}s, THROUGHPUT = {thru:.2f} samples/s"
-            )
+        resil = self._resilience
+        if resil is None and self.config.checkpoint_dir:
+            from .resilience import ResilienceManager
+
+            resil = self._resilience = ResilienceManager.from_config(self)
+        start_epoch = 0
+        if (resil is not None and self.config.auto_resume
+                and not self._auto_resumed):
+            # at most once per model object: a second fit() (keras drives
+            # one fit(epochs=1) per epoch) must NOT rewind live training
+            # state back to the on-disk checkpoint
+            self._auto_resumed = True
+            # peek the manifest BEFORE restoring: a stale checkpoint
+            # (older than this model's live progress) must be rejected
+            # without first rewinding params/opt state to it
+            peek = resil.peek_latest()
+            if peek is not None:
+                path, extras = peek
+                cur = extras.get("cursor") or {}
+                # cursor epochs are ABSOLUTE (epochs completed since
+                # compile); this fit call's within-loop index is relative
+                # to the epochs this model object already ran
+                abs_epoch = int(cur.get("epoch", 0))
+                if abs_epoch < self._epoch_base:
+                    import warnings
+
+                    warnings.warn(
+                        f"auto-resume: checkpoint {path} is older than "
+                        f"this model's live progress (epoch {abs_epoch} < "
+                        f"{self._epoch_base}) — ignored", stacklevel=2)
+                else:
+                    resil.restore_path(path)
+                    start_epoch = abs_epoch - self._epoch_base
+                    # the batch offset sticks to its ABSOLUTE epoch: when
+                    # fit is driven one epoch at a time (keras), the epoch
+                    # containing it may only be reached by a later call
+                    self._resume_cursor = (
+                        abs_epoch, int(cur.get("batch", 0)))
+        py_step = self._py_step()
+
+        import contextlib
+
+        from .resilience.fault import SimulatedPreemption
+        from .resilience.policy import PreemptionHandler
+
+        preempt = PreemptionHandler() if resil is not None else None
+        preempted = False
+        with contextlib.ExitStack() as stack:
+            if preempt is not None:
+                stack.enter_context(preempt)
+            try:
+                for epoch in range(start_epoch, epochs):
+                    abs_e = self._epoch_base + epoch
+                    order = self._epoch_order(num_samples, epoch, shuffle)
+                    t0 = time.time()
+                    b0 = 0
+                    if (self._resume_cursor is not None
+                            and abs_e >= self._resume_cursor[0]):
+                        if abs_e == self._resume_cursor[0]:
+                            b0 = self._resume_cursor[1]
+                            if b0 >= num_batches and b0 > 0:
+                                import warnings
+
+                                warnings.warn(
+                                    f"resume cursor batch {b0} does not "
+                                    f"fit {num_batches} batches (batch "
+                                    f"size changed?) — restarting the "
+                                    f"epoch", stacklevel=2)
+                                b0 = 0
+                        self._resume_cursor = None
+                    for b in range(b0, num_batches):
+                        idx = order[b * batch_size : (b + 1) * batch_size]
+                        xb = {k: v[idx] for k, v in x_dict.items()}
+                        yb = y[idx]
+                        batch = self._make_batch(xb, yb)
+                        self._rng, sub = jax.random.split(self._rng)
+                        (
+                            self._params,
+                            self._state,
+                            self._opt_slots,
+                            self._step,
+                            self._counters,
+                            lval,
+                        ) = step_fn(
+                            self._params, self._state, self._opt_slots,
+                            self._step, self._counters, sub, batch,
+                        )
+                        py_step += 1
+                        # the cursor names the NEXT batch to run on
+                        # resume; epochs are ABSOLUTE (since compile)
+                        if b + 1 >= num_batches:
+                            cursor = {"epoch": abs_e + 1, "batch": 0}
+                        else:
+                            cursor = {"epoch": abs_e, "batch": b + 1}
+                        if resil is not None:
+                            if preempt.preempted:
+                                # preemption notice: drain the in-flight
+                                # async save, then one final synchronous
+                                # snapshot — the only blocking save
+                                resil.finalize(py_step, cursor,
+                                               final_save=True)
+                                preempted = True
+                            else:
+                                resil.maybe_save(py_step, cursor)
+                        if self._fault_hook is not None:
+                            self._fault_hook(py_step)
+                        if preempted:
+                            print(f"preempted at step {py_step}: final "
+                                  f"checkpoint committed, stopping fit")
+                            return
+                    jax.block_until_ready(self._params)
+                    dt = time.time() - t0
+                    thru = (num_batches - b0) * batch_size / dt
+                    print(
+                        f"epoch {epoch}: {self.get_perf_metrics()} "
+                        f"ELAPSED TIME = {dt:.4f}s, "
+                        f"THROUGHPUT = {thru:.2f} samples/s"
+                    )
+            except SimulatedPreemption:
+                # injected death: die exactly as a real kill would — no
+                # drain, no final save, and the in-flight async write must
+                # not commit after the "kill"; only checkpoints already
+                # committed at this instant survive for auto_resume
+                if resil is not None:
+                    resil.checkpointer.abort()
+                raise
+            else:
+                # the next fit() call continues the absolute epoch count
+                # (fresh shuffle orders for keras's repeated fit(epochs=1))
+                self._epoch_base += epochs
+                if resil is not None:
+                    resil.finalize()
 
     def eval(self, x, y, batch_size: int = -1):
         assert self._compiled
@@ -1117,16 +1273,37 @@ class FFModel:
     # ------------------------------------------------ checkpoint / export
 
     def save_checkpoint(self, path: str):
-        """Sharded checkpoint of the full training state (orbax).
-        Capability beyond the reference, which has none (SURVEY §5)."""
-        from .checkpoint import save_checkpoint
+        """Synchronous atomic checkpoint of the full training state into
+        the checkpoint root `path` (resilience/checkpointer.py). Capability
+        beyond the reference, which has none (SURVEY §5)."""
+        from .resilience import ResilienceManager
 
-        return save_checkpoint(self, path)
+        # keep=0: explicit save_checkpoint calls never prune — a user
+        # saving milestones must not silently lose all but the newest few
+        mgr = ResilienceManager(self, path, keep=0)
+        mgr.save(self._py_step(), blocking=True)
+        return mgr.checkpointer.last_committed
 
     def load_checkpoint(self, path: str):
-        from .checkpoint import restore_checkpoint
+        """Restore the newest committed checkpoint under root `path` (or a
+        single checkpoint dir), resharding onto this model's mesh/Strategy
+        — the saving run's mesh may differ (resilience/reshard.py)."""
+        from .resilience import latest_checkpoint, restore_model
 
-        return restore_checkpoint(self, path)
+        target = path
+        import os
+
+        if not os.path.exists(os.path.join(path, "manifest.json")):
+            found = latest_checkpoint(path)
+            if found is None:
+                raise FileNotFoundError(
+                    f"no committed checkpoint under {path!r} (expected a "
+                    f"step_*/manifest.json layout; checkpoints written by "
+                    f"the pre-resilience orbax format are not readable — "
+                    f"re-save with save_checkpoint)")
+            target = found
+        restore_model(self, target)
+        return self
 
     def export_dot(self, path: str = "") -> str:
         """PCG DOT export (reference --compgraph flag / print_dot)."""

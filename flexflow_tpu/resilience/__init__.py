@@ -1,0 +1,48 @@
+"""Resilience subsystem: async sharded checkpointing, cross-mesh elastic
+resume, and preemption-safe training.
+
+The reference has no checkpointing at all (SURVEY §5: weights move only via
+get/set_tensor). This package makes the framework survive real pods:
+
+- `checkpointer`: copy-on-snapshot to host + background writer thread +
+  atomic commit (tmp-dir → fsync → rename → manifest), so saving never
+  blocks the step loop and a killed save never corrupts the latest-good
+  checkpoint (CheckFreq, FAST'21).
+- `reshard`: restore a checkpoint saved under one searched Strategy/mesh
+  onto a *different* mesh — every leaf is re-placed via `device_put` with
+  the new compile's NamedSharding (reshard-aware recovery, Gemini SOSP'23).
+- `policy`: CheckpointPolicy (every-N-steps / every-T-seconds / on-signal)
+  and the SIGTERM PreemptionHandler that drains the in-flight save and
+  writes a final snapshot.
+- `fault`: deterministic kill-after-step-K injection for tests.
+- `manager`: ResilienceManager gluing the above into FFModel.fit, plus the
+  `auto_resume` entry point.
+"""
+
+from .checkpointer import (
+    AsyncCheckpointer,
+    CheckpointCorruptError,
+    latest_checkpoint,
+    list_checkpoints,
+    load_checkpoint,
+)
+from .fault import FaultInjector, SimulatedPreemption
+from .manager import ResilienceManager, auto_resume
+from .policy import CheckpointPolicy, PreemptionHandler
+from .reshard import restore_model, restore_tree
+
+__all__ = [
+    "AsyncCheckpointer",
+    "CheckpointCorruptError",
+    "CheckpointPolicy",
+    "FaultInjector",
+    "PreemptionHandler",
+    "ResilienceManager",
+    "SimulatedPreemption",
+    "auto_resume",
+    "latest_checkpoint",
+    "list_checkpoints",
+    "load_checkpoint",
+    "restore_model",
+    "restore_tree",
+]

@@ -1,0 +1,355 @@
+"""Async atomic checkpoint writer.
+
+Layout under a checkpoint root directory:
+
+    <root>/
+      step_00000012/            # one committed checkpoint
+        arrays.npz              # flat keystr path -> host array bytes
+        manifest.json           # {"committed": true, "step": ..., "leaves":
+                                #  {path: {dtype, shape}}, "extras": {...}}
+      .tmp-step_00000024-<pid>/ # in-flight write, never read by restore
+      LATEST                    # convenience pointer (informational)
+
+Commit protocol (CheckFreq-style decoupled persistence):
+
+1. the train loop snapshots device state to host (`jax.device_get` — a copy,
+   so donated/overwritten device buffers can't corrupt it) and hands the
+   host tree to a background writer thread;
+2. the writer serializes everything into a `.tmp-*` directory, fsyncs the
+   files and the directory;
+3. multi-host: every process reaches a barrier, then **host 0 alone**
+   renames the tmp dir to its final `step_*` name (`os.replace` — atomic on
+   POSIX) and rewrites LATEST. The rename is the commit point: a kill at
+   any earlier moment leaves only a `.tmp-*` dir that discovery ignores.
+
+`manifest.json` is written *last* inside the tmp dir, so even a torn rename
+implementation (non-POSIX filesystems) cannot surface a half-written
+checkpoint: discovery requires a parseable manifest with "committed": true.
+
+npz preserves raw bytes but degrades non-native dtypes (bfloat16) to void;
+the manifest records each leaf's true dtype and restore re-views the bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+_STEP_DIR = re.compile(r"^step_(\d{8,})$")  # %08d grows past 8 digits ≥1e8
+_TMP_PREFIX = ".tmp-"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory failed integrity checks on load."""
+
+
+def _step_dirname(step: int) -> str:
+    return f"step_{int(step):08d}"
+
+
+def _fsync_dir(path: str):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:  # pragma: no cover - fsync of dirs unsupported somewhere
+        pass
+
+
+def flatten_tree(tree) -> dict[str, Any]:
+    """Flatten a pytree into {keystr path: leaf}. The keystr form (e.g.
+    "['params']['fc1']['kernel']") is the stable on-disk naming — restore
+    matches against the target model's identically-flattened template, so
+    resharding never needs to parse paths."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(path): leaf for path, leaf in flat}
+
+
+def to_host(leaf) -> np.ndarray:
+    """Fetch one (possibly sharded) array fully to host as a detached numpy
+    copy. Multi-process: non-addressable shards are gathered over the fleet
+    (every process ends up with the full logical array)."""
+    if jax.process_count() > 1 and hasattr(leaf, "sharding"):
+        from jax.experimental import multihost_utils
+
+        leaf = multihost_utils.process_allgather(leaf, tiled=True)
+    return np.array(jax.device_get(leaf))
+
+
+def snapshot_to_host(tree) -> dict[str, np.ndarray]:
+    """Copy-on-snapshot: the device→host copy happens here, synchronously,
+    so the step loop may donate/overwrite the device buffers immediately
+    after; serialization cost stays on the writer thread. Single-process,
+    the whole tree goes through ONE batched `jax.device_get` (per-leaf
+    fetches pay per-call dispatch on every shard); multi-process falls
+    back to the per-leaf gather path."""
+    flat = flatten_tree(tree)
+    if jax.process_count() > 1:
+        return {k: to_host(v) for k, v in flat.items()}
+    fetched = jax.device_get(flat)
+    # device_get returns fresh host copies for jax Arrays but passes
+    # through pre-existing numpy leaves by reference — detach those
+    return {
+        k: v if v is not flat[k] else np.array(v)
+        for k, v in fetched.items()
+    }
+
+
+def _encode_leaves(flat: dict[str, np.ndarray]):
+    """npz-safe arrays + true-dtype manifest entries."""
+    arrays, leaves = {}, {}
+    for i, (path, arr) in enumerate(sorted(flat.items())):
+        arr = np.asarray(arr)
+        key = f"a{i}"
+        leaves[path] = {
+            "key": key,
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+        }
+        arrays[key] = arr
+    return arrays, leaves
+
+
+def _decode_leaf(raw: np.ndarray, meta: dict) -> np.ndarray:
+    dtype = np.dtype(meta["dtype"])  # ml_dtypes registers bf16 by name
+    shape = tuple(meta["shape"])
+    if raw.dtype == dtype:
+        return raw.reshape(shape)
+    # npz degraded a non-native dtype to void bytes: re-view
+    return np.frombuffer(raw.tobytes(), dtype=dtype).reshape(shape)
+
+
+def list_checkpoints(root: str) -> list[str]:
+    """Committed checkpoint paths under `root`, oldest first. A step dir
+    only counts when its manifest parses and says committed."""
+    if not os.path.isdir(root):
+        return []
+    found = []
+    for name in os.listdir(root):
+        m = _STEP_DIR.match(name)
+        if not m:
+            continue
+        path = os.path.join(root, name)
+        try:
+            with open(os.path.join(path, "manifest.json")) as f:
+                manifest = json.load(f)
+            if manifest.get("committed"):
+                found.append((int(m.group(1)), path))
+        except (OSError, ValueError):
+            continue
+    return [p for _, p in sorted(found)]
+
+
+def latest_checkpoint(root: str) -> Optional[str]:
+    """Newest committed checkpoint under `root`, or None."""
+    ckpts = list_checkpoints(root)
+    return ckpts[-1] if ckpts else None
+
+
+def load_checkpoint(path: str) -> tuple[dict[str, np.ndarray], dict]:
+    """Read one committed checkpoint dir → (flat {path: host array},
+    manifest). Raises CheckpointCorruptError on integrity failures."""
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(f"{path}: unreadable manifest: {e}")
+    if not manifest.get("committed"):
+        raise CheckpointCorruptError(f"{path}: manifest not committed")
+    try:
+        with np.load(os.path.join(path, "arrays.npz")) as z:
+            flat = {
+                p: _decode_leaf(z[meta["key"]], meta)
+                for p, meta in manifest["leaves"].items()
+            }
+    except (OSError, ValueError, KeyError) as e:
+        raise CheckpointCorruptError(f"{path}: unreadable arrays: {e}")
+    return flat, manifest
+
+
+class AsyncCheckpointer:
+    """Background checkpoint writer with atomic commit.
+
+    At most one save is in flight; a new save first drains the previous one
+    (bounded memory: one host snapshot alive at a time). `wait()` re-raises
+    any writer-thread failure — a silent failed save must not masquerade as
+    durability."""
+
+    def __init__(self, root: str, keep: int = 3,
+                 barrier_fn: Optional[Callable[[str], None]] = None,
+                 is_committer: Optional[Callable[[], bool]] = None):
+        self.root = os.path.abspath(root)
+        self.keep = int(keep)
+        from ..distributed import barrier, is_coordinator
+
+        self._barrier = barrier_fn or barrier
+        self._is_committer = is_committer or is_coordinator
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._aborted = threading.Event()
+        self.last_committed: Optional[str] = None
+        # test hook: called between serialization and commit (fault point)
+        self._pre_commit_hook: Optional[Callable[[str], None]] = None
+
+    # ------------------------------------------------------------ save
+
+    def save(self, step: int, tree, extras: Optional[dict] = None,
+             blocking: bool = False) -> None:
+        """Snapshot `tree` (device state) and persist it as step `step`.
+        The device→host copy is synchronous (and, multi-process, a
+        fleet-wide gather — every process must call save at the same
+        step); the write + commit happen on a background thread unless
+        `blocking`. Multi-process saves are forced blocking: the commit
+        barrier is a collective, and issuing it from a writer thread while
+        the main thread runs train-step collectives would interleave
+        collectives in different orders across hosts (deadlock)."""
+        self.wait()  # drain previous save; raises its error if any
+        flat = snapshot_to_host(tree)
+        extras = dict(extras or {})
+        if blocking or jax.process_count() > 1:
+            self._write(step, flat, extras)
+            return
+        self._thread = threading.Thread(
+            target=self._write_guarded, args=(step, flat, extras),
+            name=f"ckpt-writer-{step}", daemon=True)
+        self._thread.start()
+
+    def _write_guarded(self, step, flat, extras):
+        try:
+            self._write(step, flat, extras)
+        except BaseException as e:  # surfaced by wait()
+            self._error = e
+
+    def _write(self, step: int, flat: dict[str, np.ndarray], extras: dict):
+        final = os.path.join(self.root, _step_dirname(step))
+        # only the committer serializes: every process holds the identical
+        # full logical arrays (the snapshot gathered them), so N-1 extra
+        # copies on a shared filesystem would be pure wasted bandwidth —
+        # the other processes just join the commit barriers.
+        # A serialization failure (ENOSPC...) must NOT raise before the
+        # barriers: the other hosts are already waiting in the collective
+        # and would hang the pod — record it, join the barriers, skip the
+        # commit, raise after.
+        tmp = None
+        error: Optional[BaseException] = None
+        if self._is_committer():
+            try:
+                os.makedirs(self.root, exist_ok=True)
+                tmp = os.path.join(
+                    self.root,
+                    f"{_TMP_PREFIX}{_step_dirname(step)}-{os.getpid()}")
+                if os.path.exists(tmp):
+                    shutil.rmtree(tmp)
+                os.makedirs(tmp)
+                arrays, leaves = _encode_leaves(flat)
+                arrays_path = os.path.join(tmp, "arrays.npz")
+                with open(arrays_path, "wb") as f:
+                    np.savez(f, **arrays)
+                    f.flush()
+                    os.fsync(f.fileno())
+                manifest = {
+                    "committed": True,
+                    "step": int(step),
+                    "leaves": leaves,
+                    "extras": extras,
+                    "format_version": 1,
+                }
+                # manifest last: its presence marks a complete
+                # serialization
+                man_path = os.path.join(tmp, "manifest.json")
+                with open(man_path, "w") as f:
+                    json.dump(manifest, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                _fsync_dir(tmp)
+                if self._pre_commit_hook is not None:
+                    self._pre_commit_hook(tmp)
+            except BaseException as e:
+                error = e
+        # serialization done before any process may treat the checkpoint
+        # as durable; host 0 alone renames (concurrent renames on a shared
+        # filesystem must not collide)
+        self._barrier("ckpt-precommit")
+        skip = error is not None or self._aborted.is_set()
+        if self._is_committer() and not skip:
+            displaced = None
+            if os.path.exists(final):
+                # re-saving an existing step: move the old committed dir
+                # aside with an atomic rename FIRST — an rmtree+rename pair
+                # would open a window where a kill leaves no committed
+                # checkpoint at this step at all. .old-* names never match
+                # discovery, so a crash mid-swap still shows exactly one
+                # committed state.
+                displaced = os.path.join(
+                    self.root,
+                    f".old-{_step_dirname(step)}-{os.getpid()}")
+                if os.path.exists(displaced):
+                    shutil.rmtree(displaced)
+                os.replace(final, displaced)
+            os.replace(tmp, final)  # THE commit point
+            _fsync_dir(self.root)
+            if displaced is not None:
+                shutil.rmtree(displaced, ignore_errors=True)
+            self._write_latest(final)
+            self._prune()
+        elif skip and tmp is not None:
+            # failed or aborted (simulated death): never commit; leave no
+            # half-written state behind
+            shutil.rmtree(tmp, ignore_errors=True)
+        self._barrier("ckpt-postcommit")
+        if error is not None:
+            raise error
+        if not skip:
+            self.last_committed = final
+
+    def _write_latest(self, final: str):
+        tmp = os.path.join(self.root, ".LATEST.tmp")
+        with open(tmp, "w") as f:
+            f.write(os.path.basename(final))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, "LATEST"))
+
+    def _prune(self):
+        if self.keep <= 0:
+            return
+        ckpts = list_checkpoints(self.root)
+        for path in ckpts[:-self.keep]:
+            shutil.rmtree(path, ignore_errors=True)
+
+    # ------------------------------------------------------------ drain
+
+    def wait(self):
+        """Join the in-flight save (if any); re-raise its failure."""
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e
+
+    def abort(self):
+        """Discard the in-flight save as if the process had died: the
+        writer must not commit after a (simulated) kill. An already-
+        committed write stays committed — exactly like a real kill landing
+        a moment later. The checkpointer is reusable afterwards."""
+        self._aborted.set()
+        try:
+            t, self._thread = self._thread, None
+            if t is not None:
+                t.join()
+            self._error = None
+        finally:
+            self._aborted.clear()
+
+    def close(self):
+        self.wait()

@@ -1,0 +1,121 @@
+"""ResilienceManager: glue between FFModel.fit and the checkpoint stack.
+
+Owns one AsyncCheckpointer + CheckpointPolicy for a compiled model, knows
+how to snapshot the model's full training state (reshard.model_state_tree)
+with the fit loop's cursor, and restores the newest committed checkpoint
+(`auto_resume`) before training.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .checkpointer import AsyncCheckpointer, latest_checkpoint
+from .policy import CheckpointPolicy
+from .reshard import model_state_tree, restore_model
+
+
+class ResilienceManager:
+    def __init__(self, ffmodel, directory: str,
+                 policy: Optional[CheckpointPolicy] = None, keep: int = 3):
+        self.ffmodel = ffmodel
+        self.directory = directory
+        self.policy = policy or CheckpointPolicy()
+        self.checkpointer = AsyncCheckpointer(directory, keep=keep)
+
+    @classmethod
+    def from_config(cls, ffmodel) -> Optional["ResilienceManager"]:
+        """Build from FFConfig's --checkpoint-* flags; None when
+        checkpointing is not configured."""
+        cfg = ffmodel.config
+        if not cfg.checkpoint_dir:
+            return None
+        policy = CheckpointPolicy(
+            every_n_steps=cfg.checkpoint_every,
+            every_t_seconds=cfg.checkpoint_every_seconds,
+        )
+        return cls(ffmodel, cfg.checkpoint_dir, policy,
+                   keep=cfg.checkpoint_keep)
+
+    # ------------------------------------------------------------ saving
+
+    def _extras(self, step: int, cursor: Optional[dict]) -> dict:
+        mesh = self.ffmodel.mesh
+        return {
+            # cursor epochs are ABSOLUTE (epochs completed since compile):
+            # model.fit maps them back onto its within-call loop index and
+            # keys the deterministic shuffle order on them
+            "cursor": dict(cursor or {}),
+            "py_step": int(step),
+            "mesh_axes": {k: int(v) for k, v in mesh.shape.items()}
+            if mesh is not None else {},
+        }
+
+    def maybe_save(self, step: int, cursor: Optional[dict] = None) -> bool:
+        """Policy-gated async save after optimizer step `step`."""
+        if not self.policy.should_save(step):
+            return False
+        self.save(step, cursor, blocking=False)
+        return True
+
+    def save(self, step: int, cursor: Optional[dict] = None,
+             blocking: bool = False):
+        self.checkpointer.save(
+            step, model_state_tree(self.ffmodel),
+            extras=self._extras(step, cursor), blocking=blocking)
+        self.policy.notify_saved()
+
+    def finalize(self, step: Optional[int] = None,
+                 cursor: Optional[dict] = None, final_save: bool = False):
+        """Drain the in-flight async save; optionally write one last
+        synchronous snapshot (the preemption path)."""
+        self.checkpointer.wait()
+        if final_save and step is not None:
+            self.save(step, cursor, blocking=True)
+
+    # ------------------------------------------------------------ restore
+
+    def peek_latest(self) -> Optional[tuple]:
+        """(path, extras) of the newest committed checkpoint WITHOUT
+        restoring it — fit uses this to judge cursor staleness before
+        rewinding any live state. None when no committed checkpoint
+        exists."""
+        import json
+        import os
+
+        path = latest_checkpoint(self.directory)
+        if path is None:
+            return None
+        try:
+            with open(os.path.join(path, "manifest.json")) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return path, dict(manifest.get("extras") or {})
+
+    def restore_path(self, path: str) -> dict:
+        """Restore one committed checkpoint dir (resharding onto this
+        model's mesh/Strategy); returns its extras."""
+        return restore_model(self.ffmodel, path)
+
+    def restore_latest(self) -> Optional[dict]:
+        """Restore the newest committed checkpoint (resharding onto this
+        model's mesh/Strategy). Returns the saved extras (cursor...) or
+        None when no committed checkpoint exists."""
+        path = latest_checkpoint(self.directory)
+        if path is None:
+            return None
+        return restore_model(self.ffmodel, path)
+
+
+def auto_resume(ffmodel, directory: Optional[str] = None) -> Optional[dict]:
+    """Discover the newest committed checkpoint under `directory` (default:
+    the model's --checkpoint-dir) and restore it into the compiled model.
+    Returns the saved extras dict, or None when starting fresh."""
+    directory = directory or ffmodel.config.checkpoint_dir
+    if not directory:
+        return None
+    path = latest_checkpoint(directory)
+    if path is None:
+        return None
+    return restore_model(ffmodel, path)

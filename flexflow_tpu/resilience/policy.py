@@ -1,0 +1,96 @@
+"""When to checkpoint, and how to die gracefully.
+
+CheckpointPolicy decides *when* a snapshot is taken (every N steps, every T
+seconds, or both — whichever fires first). PreemptionHandler turns SIGTERM
+(the cloud preemption notice on TPU spot/preemptible VMs) into a flag the
+fit loop polls between steps: on notice, the loop drains the in-flight
+async save, writes one final snapshot, and returns — the CheckFreq
+decoupling means the final save is the only synchronous one.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class CheckpointPolicy:
+    """every_n_steps=0 and every_t_seconds=0 → only explicit/final saves."""
+
+    every_n_steps: int = 0
+    every_t_seconds: float = 0.0
+
+    def __post_init__(self):
+        self._last_save_time = time.monotonic()
+        if self.every_t_seconds > 0:
+            import jax
+
+            if jax.process_count() > 1:
+                # wall-clock triggers read each host's own clock: skew
+                # would make hosts decide to save at different steps, and
+                # the snapshot gather is a fleet-wide collective — a
+                # divergent decision hangs the pod. Only the step-count
+                # trigger is deterministic across hosts.
+                import warnings
+
+                warnings.warn(
+                    "every_t_seconds is not multi-host safe (clock skew "
+                    "diverges the save decision across processes); "
+                    "disabled — use every_n_steps", stacklevel=2)
+                self.every_t_seconds = 0.0
+
+    def should_save(self, step: int) -> bool:
+        if self.every_n_steps > 0 and step % self.every_n_steps == 0:
+            return True
+        if (self.every_t_seconds > 0
+                and time.monotonic() - self._last_save_time
+                >= self.every_t_seconds):
+            return True
+        return False
+
+    def notify_saved(self):
+        self._last_save_time = time.monotonic()
+
+
+class PreemptionHandler:
+    """Context manager installing a SIGTERM (and optionally SIGINT) handler
+    that records the preemption instead of killing the process mid-save.
+    The previous handler is chained on exit; installation is skipped off the
+    main thread (signal module restriction) — `preempted` then only reflects
+    `request()` calls (the test hook)."""
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self._flag = threading.Event()
+        self._previous: dict = {}
+
+    @property
+    def preempted(self) -> bool:
+        return self._flag.is_set()
+
+    def request(self):
+        """Programmatic preemption notice (tests / external schedulers)."""
+        self._flag.set()
+
+    def _handle(self, signum, frame):
+        self._flag.set()
+
+    def __enter__(self):
+        for s in self.signals:
+            try:
+                self._previous[s] = signal.signal(s, self._handle)
+            except ValueError:  # not on the main thread
+                pass
+        return self
+
+    def __exit__(self, *exc):
+        for s, prev in self._previous.items():
+            try:
+                signal.signal(s, prev)
+            except ValueError:  # pragma: no cover - defensive
+                pass
+        self._previous.clear()
+        return False

@@ -1,0 +1,107 @@
+"""Cross-mesh elastic resume: re-place checkpointed leaves under a new
+mesh/Strategy.
+
+Checkpoints store each leaf as its full *logical* array on host (the
+snapshot gathers shards), which makes them mesh-independent by
+construction: restoring onto a different searched Strategy is a
+`device_put` of the logical array with the *target* compile's
+NamedSharding — GSPMD then owns slicing it onto the new mesh (e.g. save
+under dp=8, resume under dp=4×tp=2). This is the reshard-aware recovery
+path of Gemini (SOSP'23) recast onto JAX shardings.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .checkpointer import CheckpointCorruptError, load_checkpoint
+
+
+def place_like(host_arr: np.ndarray, template_leaf):
+    """Place one host array like `template_leaf`: same dtype, and the
+    template's NamedSharding when it has one (the cross-mesh re-placement).
+    The host numpy array goes straight into device_put — materializing the
+    full logical array on one device first would OOM exactly the models
+    that are sharded because they don't fit on one device."""
+    dtype = getattr(template_leaf, "dtype", None)
+    sharding = getattr(template_leaf, "sharding", None)
+    if sharding is not None:
+        arr = np.asarray(host_arr)
+        if dtype is not None and arr.dtype != dtype:
+            arr = arr.astype(dtype)
+        return jax.device_put(arr, sharding)
+    return jnp.asarray(host_arr, dtype)
+
+
+def restore_tree(template, flat_arrays: dict[str, np.ndarray], prefix: str = "",
+                 label: str = "checkpoint"):
+    """Rebuild `template`'s pytree from saved flat arrays, re-placing every
+    leaf with the template leaf's sharding. Path mismatches raise — a
+    silently dropped leaf (the old `_state or {}` failure mode) would train
+    from stale values with no sign anything was lost."""
+    flat_t, treedef = jax.tree_util.tree_flatten_with_path(template)
+    missing = []
+    leaves = []
+    for path, leaf in flat_t:
+        key = prefix + jax.tree_util.keystr(path)
+        if key not in flat_arrays:
+            missing.append(key)
+            continue
+        saved = flat_arrays[key]
+        want = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        if tuple(saved.shape) != want:
+            raise CheckpointCorruptError(
+                f"{label}: leaf {key} has shape {tuple(saved.shape)} but the "
+                f"compiled model expects {want} — architecture mismatch")
+        leaves.append(place_like(saved, leaf))
+    if missing:
+        raise CheckpointCorruptError(
+            f"{label}: {len(missing)} leaves absent from checkpoint "
+            f"(architecture mismatch?): {missing[:5]}")
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+_SECTIONS = ("params", "state", "opt_slots", "step", "counters")
+
+
+def model_state_tree(ffmodel) -> dict:
+    """The full training state persisted per checkpoint. `state` may be
+    None/{} (no stateful ops) — normalized to {} so save/restore treat both
+    spellings identically."""
+    return {
+        "params": ffmodel._params,
+        "state": ffmodel._state if ffmodel._state is not None else {},
+        "opt_slots": ffmodel._opt_slots,
+        "step": ffmodel._step,
+        "counters": ffmodel._counters,
+        "rng": jax.random.key_data(ffmodel._rng),
+    }
+
+
+def restore_model(ffmodel, path: str) -> dict:
+    """Restore a committed checkpoint dir into a *compiled* FFModel whose
+    mesh/Strategy may differ from the saving run's. Returns the manifest's
+    extras dict (train-loop cursor, wallclock, saving mesh...)."""
+    assert ffmodel._compiled, "compile() before restoring a checkpoint"
+    flat, manifest = load_checkpoint(path)
+
+    saved_state_keys = [k for k in flat if k.startswith("['state']")]
+    template = model_state_tree(ffmodel)
+    if not template["state"] and saved_state_keys:
+        # the checkpoint carries op state this compile has no home for —
+        # the exact case checkpoint.py's `_state or {}` used to drop
+        raise CheckpointCorruptError(
+            f"{path}: checkpoint has op state {saved_state_keys[:3]} but the "
+            "compiled model has none — architecture mismatch")
+
+    restored = restore_tree(template, flat, label=path)
+    ffmodel._params = restored["params"]
+    ffmodel._state = restored["state"] if restored["state"] else ffmodel._state
+    ffmodel._opt_slots = restored["opt_slots"]
+    ffmodel._step = restored["step"]
+    ffmodel._counters = restored["counters"]
+    ffmodel._rng = jax.random.wrap_key_data(
+        jax.device_get(restored["rng"]).astype(np.uint32))
+    return dict(manifest.get("extras") or {})

@@ -1,0 +1,118 @@
+"""Measure async checkpointing overhead (acceptance: steps/sec with
+--checkpoint-every enabled within 10% of the no-checkpoint baseline).
+
+Two numbers, because the CPU test rig conflates them:
+
+- **blocking cost**: what the step loop actually pays per save — the
+  copy-on-snapshot (batched `jax.device_get`) + writer-thread handoff.
+  This is the cost TPU training would see, where the background writer
+  runs on otherwise-idle host cores while devices compute.
+- **wall-clock overhead**: total fit-time delta on the 8-virtual-device
+  CPU mesh, where the writer thread *competes with XLA for the same
+  cores* — an artifact absent on real TPU hosts. Measured interleaved
+  (min-of-N, load drift hits both configurations equally) at a dense
+  cadence (every 8 steps ≈ every 90ms here; real runs checkpoint every
+  minutes) and a moderate one (every 32).
+
+The headline JSON line prints LAST.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault(
+    "XLA_FLAGS",
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def _build(argv):
+    sys.argv = ["bench_checkpoint", *argv]
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, SGDOptimizer,
+    )
+
+    config = FFConfig()
+    config.mesh_axis_sizes = (8, 1, 1, 1)
+    config.batch_size = 8
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 64), name="x")
+    t = ff.dense(x, 256, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 256, ActiMode.AC_MODE_RELU, name="fc2")
+    t = ff.dense(t, 8, name="head")
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def _timed_fit(ff, x, y, epochs):
+    t0 = time.perf_counter()
+    ff.fit(x, y, epochs=epochs, batch_size=8, shuffle=False)
+    return time.perf_counter() - t0
+
+
+def main():
+    rs = np.random.RandomState(0)
+    x = rs.randn(1024, 64).astype(np.float32)
+    y = rs.randint(0, 8, (1024, 1)).astype(np.int32)
+
+    # ---- blocking cost per save: snapshot + async handoff, measured on
+    # the exact state tree fit checkpoints
+    from flexflow_tpu.resilience.checkpointer import snapshot_to_host
+    from flexflow_tpu.resilience.reshard import model_state_tree
+
+    probe = _build([])
+    probe.fit(x[:64], y[:64], epochs=1, batch_size=8, shuffle=False)
+    tree = model_state_tree(probe)
+    snapshot_to_host(tree)  # warm
+    t_snap = min(
+        (lambda t0: (snapshot_to_host(tree), time.perf_counter() - t0)[1])(
+            time.perf_counter())
+        for _ in range(10))
+
+    results = []
+    with tempfile.TemporaryDirectory() as root:
+        bare = _build([])
+        _timed_fit(bare, x, y, 1)  # warm
+        # the LAST-printed (headline) line must be the documented
+        # acceptance cadence (32), not the dense contention-artifact one
+        for every in (8, 32):
+            ck = _build(["--checkpoint-dir", os.path.join(root, str(every)),
+                         "--checkpoint-every", str(every)])
+            _timed_fit(ck, x, y, 1)  # warm
+            # interleave so machine-load drift hits both configs equally
+            t_bare = t_ck = float("inf")
+            for _ in range(5):
+                t_bare = min(t_bare, _timed_fit(bare, x, y, 2))
+                t_ck = min(t_ck, _timed_fit(ck, x, y, 2))
+            results.append({
+                "checkpoint_every": every,
+                "overhead_frac": round(t_ck / t_bare - 1.0, 4),
+                "baseline_s": round(t_bare, 4),
+                "with_checkpoint_s": round(t_ck, 4),
+            })
+
+    for r in results[:-1]:
+        print(json.dumps({"metric": "async_checkpoint_overhead_frac", **r,
+                          "note": "CPU-rig wall-clock (writer competes "
+                                  "with XLA for cores; absent on TPU)"}))
+    head = results[-1]
+    print(json.dumps({
+        "metric": "async_checkpoint_overhead_frac",
+        **head,
+        "blocking_cost_ms_per_save": round(t_snap * 1e3, 2),
+        "within_10pct": bool(head["overhead_frac"] < 0.10),
+        "note": "CPU-rig wall-clock; step-loop blocking cost is "
+                "blocking_cost_ms_per_save (the TPU-relevant number)",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,635 @@
+"""Resilience subsystem tests (resilience/): atomic async checkpointing,
+cross-mesh elastic resume, preemption-safe fit, fault injection.
+
+The headline scenario: a run killed mid-fit (deterministic kill-after-step-K
+injection) auto-resumes from the last committed checkpoint onto a *different*
+mesh shape (dp=8 → dp=4×tp=2, dp=2×pp=4) and reaches the same final weights/
+metrics as an uninterrupted run on the 8-device CPU mesh.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.quick
+
+
+DP8 = (8, 1, 1, 1)
+DP4_TP2 = (4, 2, 1, 1)
+DP2_PP4 = (2, 1, 4, 1)
+
+
+def _mlp(batch=8, mesh=DP8, seed=0, argv=()):
+    sys.argv = ["test", *argv]
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+
+    config = FFConfig()
+    config.mesh_axis_sizes = mesh
+    config.batch_size = batch
+    config.seed = seed
+    ff = FFModel(config)
+    x = ff.create_tensor((batch, 16), name="x")
+    t = ff.dense(x, 32, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 4, name="fc2")
+    t = ff.softmax(t, name="sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    return ff
+
+
+def _data(n=64, d=16, k=4, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, d).astype(np.float32)
+    y = rs.randint(0, k, (n, 1)).astype(np.int32)
+    return x, y
+
+
+def _weights(ff):
+    import jax
+
+    return {
+        "fc1": np.asarray(jax.device_get(ff.get_weight("fc1", "kernel"))),
+        "fc2": np.asarray(jax.device_get(ff.get_weight("fc2", "kernel"))),
+    }
+
+
+# ===================================================================
+# checkpointer: atomicity + discovery + async semantics
+# ===================================================================
+
+def test_atomic_commit_discovery_ignores_tmp_and_torn(tmp_path):
+    """Discovery must see only committed checkpoints: in-flight .tmp-* dirs,
+    step dirs without a manifest, and torn manifests are all invisible."""
+    from flexflow_tpu.resilience import (
+        AsyncCheckpointer, latest_checkpoint, list_checkpoints)
+
+    root = str(tmp_path / "ck")
+    ck = AsyncCheckpointer(root)
+    tree = {"params": {"w": np.arange(4, dtype=np.float32)}}
+    ck.save(3, tree, blocking=True)
+    good = latest_checkpoint(root)
+    assert good and good.endswith("step_00000003")
+
+    # a killed save: tmp dir with full contents but never renamed
+    os.makedirs(os.path.join(root, ".tmp-step_00000009-12345"))
+    # a torn checkpoint: step dir with half a manifest
+    torn = os.path.join(root, "step_00000007")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "manifest.json"), "w") as f:
+        f.write('{"committed": tr')  # truncated mid-write
+    # a step dir with no manifest at all
+    os.makedirs(os.path.join(root, "step_00000005"))
+
+    assert latest_checkpoint(root) == good
+    assert list_checkpoints(root) == [good]
+
+
+def test_interrupted_async_save_never_corrupts_latest(tmp_path):
+    """Acceptance: an async save that dies before its commit point leaves
+    the previous latest-good checkpoint untouched and discoverable."""
+    from flexflow_tpu.resilience import (
+        AsyncCheckpointer, latest_checkpoint, load_checkpoint)
+
+    root = str(tmp_path / "ck")
+    ck = AsyncCheckpointer(root)
+    v1 = {"params": {"w": np.full(4, 1.0, np.float32)}}
+    ck.save(1, v1, blocking=True)
+    first = latest_checkpoint(root)
+
+    # kill the writer between serialization and commit
+    def die(tmpdir):
+        raise KeyboardInterrupt("process killed mid-save")
+
+    ck._pre_commit_hook = die
+    ck.save(2, {"params": {"w": np.full(4, 2.0, np.float32)}}, blocking=False)
+    with pytest.raises(KeyboardInterrupt):
+        ck.wait()
+
+    assert latest_checkpoint(root) == first
+    flat, manifest = load_checkpoint(first)
+    np.testing.assert_array_equal(flat["['params']['w']"], v1["params"]["w"])
+    assert manifest["step"] == 1
+
+    # and the checkpointer recovers: the next save commits normally
+    ck._pre_commit_hook = None
+    ck.save(3, {"params": {"w": np.full(4, 3.0, np.float32)}}, blocking=True)
+    assert latest_checkpoint(root).endswith("step_00000003")
+
+
+def test_async_save_overlaps_and_prunes(tmp_path):
+    """Async saves commit in the background; keep=2 prunes the oldest."""
+    from flexflow_tpu.resilience import AsyncCheckpointer, list_checkpoints
+
+    root = str(tmp_path / "ck")
+    ck = AsyncCheckpointer(root, keep=2)
+    for s in (1, 2, 3):
+        ck.save(s, {"w": np.full(8, float(s), np.float32)}, blocking=False)
+    ck.wait()
+    names = [os.path.basename(p) for p in list_checkpoints(root)]
+    assert names == ["step_00000002", "step_00000003"]
+    with open(os.path.join(root, "LATEST")) as f:
+        assert f.read() == "step_00000003"
+
+
+def test_bf16_and_int_leaves_roundtrip(tmp_path):
+    """npz degrades bfloat16 to raw void bytes; the manifest's recorded
+    dtype must reconstruct it exactly (and ints/scalars survive too)."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.resilience import (
+        AsyncCheckpointer, latest_checkpoint, load_checkpoint)
+    from flexflow_tpu.resilience.checkpointer import snapshot_to_host
+
+    tree = {
+        "bf16": jnp.arange(6, dtype=jnp.bfloat16) / 3,
+        "i32": jnp.int32(7),
+        "f32": jnp.ones((2, 2), jnp.float32) * 0.5,
+    }
+    root = str(tmp_path / "ck")
+    ck = AsyncCheckpointer(root)
+    ck.save(0, tree, blocking=True)
+    flat, _ = load_checkpoint(latest_checkpoint(root))
+    want = snapshot_to_host(tree)
+    for k, v in want.items():
+        assert flat[k].dtype == v.dtype, k
+        np.testing.assert_array_equal(flat[k], v)
+
+
+def test_abort_discards_inflight_save(tmp_path):
+    """abort() models process death: an in-flight async save must never
+    commit afterwards; the checkpointer stays usable."""
+    from flexflow_tpu.resilience import AsyncCheckpointer, list_checkpoints
+
+    root = str(tmp_path / "ck")
+    ck = AsyncCheckpointer(root)
+    ck.save(1, {"w": np.zeros(2, np.float32)}, blocking=True)
+
+    # the writer stalls pre-commit until the "kill" lands — deterministic:
+    # abort() raises the flag (releasing the hook) before joining
+    ck._pre_commit_hook = lambda tmpdir: ck._aborted.wait(5)
+    ck.save(2, {"w": np.ones(2, np.float32)}, blocking=False)
+    ck.abort()
+    names = [os.path.basename(p) for p in list_checkpoints(root)]
+    assert names == ["step_00000001"]  # step 2 never committed
+
+    ck._pre_commit_hook = None
+    ck.save(3, {"w": np.ones(2, np.float32)}, blocking=True)  # reusable
+    assert [os.path.basename(p) for p in list_checkpoints(root)] == [
+        "step_00000001", "step_00000003"]
+
+
+def test_same_step_overwrite_stays_committed(tmp_path):
+    """Re-saving an existing step swaps the dirs via atomic renames: the
+    new content lands, no .old-* garbage survives, discovery always sees
+    exactly one committed checkpoint for the step."""
+    from flexflow_tpu.resilience import (
+        AsyncCheckpointer, latest_checkpoint, load_checkpoint)
+
+    root = str(tmp_path / "ck")
+    ck = AsyncCheckpointer(root)
+    ck.save(5, {"w": np.full(2, 1.0, np.float32)}, blocking=True)
+    ck.save(5, {"w": np.full(2, 2.0, np.float32)}, blocking=True)
+    flat, _ = load_checkpoint(latest_checkpoint(root))
+    np.testing.assert_array_equal(flat["['w']"], np.full(2, 2.0, np.float32))
+    assert not [n for n in os.listdir(root) if n.startswith(".old-")]
+
+
+def test_writer_error_surfaces_on_wait(tmp_path, monkeypatch):
+    """A failed background write must raise at the next wait(), not vanish
+    (silent failed saves would masquerade as durability)."""
+    from flexflow_tpu.resilience import AsyncCheckpointer
+
+    ck = AsyncCheckpointer(str(tmp_path / "ck"))
+
+    def boom(tmpdir):
+        raise OSError("disk full")
+
+    ck._pre_commit_hook = boom
+    ck.save(1, {"w": np.zeros(2, np.float32)}, blocking=False)
+    with pytest.raises(OSError, match="disk full"):
+        ck.wait()
+
+
+# ===================================================================
+# cross-mesh elastic resume
+# ===================================================================
+
+@pytest.mark.parametrize("resume_mesh", [DP4_TP2, DP2_PP4, DP8],
+                         ids=["dp4xtp2", "dp2xpp4", "same-dp8"])
+def test_cross_mesh_resume_bit_identical(tmp_path, resume_mesh):
+    """Save under dp=8, restore under a different factorization of the same
+    8 chips: the resumed loss trajectory continues exactly (identical final
+    weights and metric counters vs the uninterrupted run)."""
+    import jax
+
+    x, y = _data(64)
+    root = str(tmp_path / "ck")
+
+    # uninterrupted reference: 2 epochs straight through (deterministic
+    # seeded shuffle)
+    ref = _mlp(mesh=DP8)
+    ref.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+    ref_w = _weights(ref)
+    ref_counters = jax.device_get(ref._counters)
+
+    # run 1: one epoch under dp=8, checkpoint, stop
+    ff1 = _mlp(mesh=DP8)
+    ff1.fit(x, y, epochs=1, batch_size=8, shuffle=True)
+    mgr1 = ff1.enable_checkpointing(root)
+    mgr1.save(int(np.asarray(jax.device_get(ff1._step))),
+              cursor={"epoch": 1, "batch": 0}, blocking=True)
+
+    # run 2: fresh process analog — new model, DIFFERENT mesh, auto-resume
+    ff2 = _mlp(mesh=resume_mesh,
+               argv=["--checkpoint-dir", root, "--auto-resume"])
+    from flexflow_tpu.resilience import auto_resume
+
+    extras = auto_resume(ff2, root)
+    assert extras is not None and extras["cursor"] == {"epoch": 1, "batch": 0}
+    assert extras["mesh_axes"]["data"] == 8  # saved on dp=8
+    assert int(np.asarray(jax.device_get(ff2._step))) == 8  # 64/8 steps
+
+    # every restored param carries the NEW mesh's sharding
+    w = ff2._params["fc1"]["kernel"]
+    assert w.sharding.mesh.shape == ff2.mesh.shape
+
+    # second epoch on the new mesh continues the exact trajectory (fit
+    # re-restores via --auto-resume and starts at the saved cursor)
+    ff2.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+    got_w = _weights(ff2)
+    for k in ref_w:
+        np.testing.assert_allclose(got_w[k], ref_w[k], rtol=2e-4, atol=1e-6,
+                                   err_msg=f"weight {k} diverged")
+    got_counters = jax.device_get(ff2._counters)
+    for k in ref_counters:
+        np.testing.assert_allclose(
+            np.asarray(got_counters[k]), np.asarray(ref_counters[k]),
+            rtol=2e-4, atol=1e-6, err_msg=f"counter {k} diverged")
+
+
+def test_resume_epoch_cursor_skips_done_epochs(tmp_path):
+    """auto_resume inside fit() starts from the saved (epoch, batch) — the
+    already-finished epoch is not re-run (step counter proves it)."""
+    import jax
+
+    x, y = _data(32)
+    root = str(tmp_path / "ck")
+
+    ff1 = _mlp(mesh=DP8, batch=8)
+    ff1.enable_checkpointing(root)
+    ff1.fit(x, y, epochs=1, batch_size=8, shuffle=True)
+    mgr = ff1._resilience
+    mgr.save(int(np.asarray(jax.device_get(ff1._step))),
+             cursor={"epoch": 1, "batch": 0}, blocking=True)
+
+    ff2 = _mlp(mesh=DP8, batch=8,
+               argv=["--checkpoint-dir", root, "--auto-resume"])
+    assert ff2.config.auto_resume and ff2.config.checkpoint_dir == root
+    ff2.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+    # 32/8 = 4 steps/epoch: epoch 0 restored (4 steps), epoch 1 run (4 more)
+    assert int(np.asarray(jax.device_get(ff2._step))) == 8
+
+
+# ===================================================================
+# preemption-safe fit: fault injection + SIGTERM drain
+# ===================================================================
+
+@pytest.mark.parametrize("resume_mesh", [DP4_TP2, DP2_PP4],
+                         ids=["dp4xtp2", "dp2xpp4"])
+def test_kill_after_step_k_auto_resume_cross_mesh(tmp_path, resume_mesh):
+    """THE acceptance scenario: mid-fit death at step K (between periodic
+    checkpoints) → auto-resume onto a different mesh → final weights match
+    the uninterrupted run within fp tolerance."""
+    import jax
+
+    from flexflow_tpu.resilience import (
+        FaultInjector, SimulatedPreemption, latest_checkpoint)
+
+    x, y = _data(64)
+    root = str(tmp_path / "ck")
+
+    ref = _mlp(mesh=DP8)
+    ref.fit(x, y, epochs=2, batch_size=8, shuffle=True)  # 16 steps total
+    ref_w = _weights(ref)
+
+    # killed run: checkpoint every 2 steps, die after step 5 (NOT on a
+    # checkpoint boundary — the last committed state is step 4)
+    ff1 = _mlp(mesh=DP8, argv=["--checkpoint-dir", root,
+                               "--checkpoint-every", "2"])
+    fault = FaultInjector(kill_after_step=5)
+    ff1.set_fault_hook(fault)
+    with pytest.raises(SimulatedPreemption):
+        ff1.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+    assert fault.fired
+    del ff1  # the process is dead
+
+    last = latest_checkpoint(root)
+    assert last is not None and int(last[-8:]) <= 5
+
+    # resumed run: different mesh, --auto-resume, same data/epochs
+    ff2 = _mlp(mesh=resume_mesh, argv=["--checkpoint-dir", root,
+                                       "--auto-resume"])
+    ff2.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+    assert int(np.asarray(jax.device_get(ff2._step))) == 16
+    got = _weights(ff2)
+    for k in ref_w:
+        np.testing.assert_allclose(got[k], ref_w[k], rtol=2e-4, atol=1e-6,
+                                   err_msg=f"weight {k} diverged after "
+                                           f"kill/resume on {resume_mesh}")
+
+
+def test_sigterm_drains_and_writes_final_snapshot(tmp_path):
+    """A preemption notice mid-fit stops the loop after the current step,
+    drains the async save, and commits a final snapshot whose cursor
+    resumes exactly where training stopped."""
+    import jax
+
+    from flexflow_tpu.resilience import latest_checkpoint, load_checkpoint
+
+    x, y = _data(64)
+    root = str(tmp_path / "ck")
+
+    ff = _mlp(mesh=DP8, argv=["--checkpoint-dir", root])
+
+    # deliver the "SIGTERM" after step 3 via the fault hook slot (signal
+    # delivery itself is covered by test_preemption_handler_signal)
+    def notice(step):
+        if step == 3:
+            _handler_holder[0].request()
+
+    _handler_holder = [None]
+
+    # intercept the handler fit installs
+    from flexflow_tpu.resilience import policy as pol
+
+    orig_enter = pol.PreemptionHandler.__enter__
+
+    def capture_enter(self):
+        _handler_holder[0] = self
+        return orig_enter(self)
+
+    pol.PreemptionHandler.__enter__ = capture_enter
+    try:
+        ff.set_fault_hook(notice)
+        ff.fit(x, y, epochs=2, batch_size=8, shuffle=True)  # returns early
+    finally:
+        pol.PreemptionHandler.__enter__ = orig_enter
+
+    assert int(np.asarray(jax.device_get(ff._step))) == 4  # stopped at 4
+    last = latest_checkpoint(root)
+    assert last is not None and last.endswith("step_00000004")
+    _, manifest = load_checkpoint(last)
+    assert manifest["extras"]["cursor"] == {"epoch": 0, "batch": 4}
+
+
+def test_preemption_handler_signal():
+    """Real SIGTERM delivery sets the flag and the previous handler is
+    restored on exit."""
+    import signal
+
+    from flexflow_tpu.resilience import PreemptionHandler
+
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionHandler() as h:
+        assert not h.preempted
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert h.preempted
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_fault_injector_contract():
+    from flexflow_tpu.resilience import FaultInjector, SimulatedPreemption
+
+    with pytest.raises(ValueError):
+        FaultInjector(0)
+    f = FaultInjector(3)
+    f(1)
+    f(2)
+    with pytest.raises(SimulatedPreemption) as ei:
+        f(3)
+    assert ei.value.step == 3 and f.fired
+    f(4)  # fires only once — the process would already be dead
+
+
+# ===================================================================
+# satellites: dataloader cursor, deprecated wrappers, state-drop bugfix
+# ===================================================================
+
+def test_dataloader_resumable_cursor():
+    ff = _mlp(batch=4)
+    data = np.random.RandomState(0).randn(12, 16).astype(np.float32)
+    loader = ff.create_data_loader(ff._input_tensors[0], data)
+    loader.next_batch()
+    sd = loader.state_dict()
+    assert sd == {"next_index": 4}
+    b_expected = loader.next_batch()
+
+    loader2 = ff.create_data_loader(ff._input_tensors[0], data)
+    loader2.load_state_dict(sd)
+    np.testing.assert_array_equal(loader2.next_batch(), b_expected)
+    with pytest.raises(ValueError, match="out of range"):
+        loader2.load_state_dict({"next_index": 999})
+
+
+def test_deprecated_checkpoint_api_roundtrips(tmp_path):
+    """The old module-level API still works (routed through the resilience
+    subsystem) and warns about its deprecation."""
+    from flexflow_tpu import checkpoint as ckpt
+
+    ff = _mlp()
+    x, y = _data(16)
+    ff.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+    w = ff.get_weight("fc1", "kernel")
+    path = str(tmp_path / "old_api")
+    with pytest.warns(DeprecationWarning, match="deprecated"):
+        ckpt.save_checkpoint(ff, path)
+
+    ff2 = _mlp(mesh=DP4_TP2)  # even the old API reshards now
+    with pytest.warns(DeprecationWarning):
+        ckpt.restore_checkpoint(ff2, path)
+    np.testing.assert_allclose(ff2.get_weight("fc1", "kernel"), w,
+                               rtol=1e-6, atol=0)
+
+
+def test_restore_rejects_architecture_mismatch(tmp_path):
+    """Leaf mismatches raise loudly instead of silently dropping state (the
+    old `_state or {}` failure mode)."""
+    from flexflow_tpu.resilience import CheckpointCorruptError
+
+    ff = _mlp()
+    path = str(tmp_path / "ck")
+    ff.save_checkpoint(path)
+
+    sys.argv = ["test"]
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+
+    config = FFConfig()
+    config.mesh_axis_sizes = DP8
+    config.batch_size = 8
+    other = FFModel(config)
+    xt = other.create_tensor((8, 16), name="x")
+    t = other.dense(xt, 48, ActiMode.AC_MODE_RELU, name="fc1")  # 48 != 32
+    t = other.dense(t, 4, name="fc2")
+    t = other.softmax(t, name="sm")
+    other.compile(optimizer=SGDOptimizer(lr=0.05),
+                  loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.METRICS_ACCURACY])
+    with pytest.raises(CheckpointCorruptError, match="shape"):
+        other.load_checkpoint(path)
+
+
+def test_resume_through_per_epoch_fit_calls(tmp_path):
+    """The keras driver calls fit(epochs=1) once per epoch. A mid-epoch
+    checkpoint resumed through that driver must land its batch offset on
+    the correct ABSOLUTE epoch (reached only by a later inner fit call)
+    and reproduce the uninterrupted run exactly."""
+    import jax
+
+    from flexflow_tpu.resilience import (
+        FaultInjector, SimulatedPreemption)
+
+    x, y = _data(64)  # 8 batches/epoch
+    root = str(tmp_path / "ck")
+
+    ref = _mlp(mesh=DP8)
+    for _ in range(3):  # the keras per-epoch pattern
+        ref.fit(x, y, epochs=1, batch_size=8, shuffle=True)
+    ref_w = _weights(ref)
+
+    # killed run: die mid-epoch-1 (step 13 = epoch 1, batch 5)
+    ff1 = _mlp(mesh=DP8, argv=["--checkpoint-dir", root,
+                               "--checkpoint-every", "3"])
+    ff1.set_fault_hook(FaultInjector(kill_after_step=13))
+    with pytest.raises(SimulatedPreemption):
+        for _ in range(3):
+            ff1.fit(x, y, epochs=1, batch_size=8, shuffle=True)
+
+    # restart, also driven per-epoch: inner fit 1 restores (cursor in
+    # absolute epoch 1) and trains nothing or the tail of epoch 0; the
+    # later calls pick up the cursor's epoch mid-way
+    ff2 = _mlp(mesh=DP4_TP2, argv=["--checkpoint-dir", root,
+                                   "--auto-resume"])
+    for _ in range(3):
+        ff2.fit(x, y, epochs=1, batch_size=8, shuffle=True)
+    assert int(np.asarray(jax.device_get(ff2._step))) == 24
+    got = _weights(ff2)
+    for k in ref_w:
+        np.testing.assert_allclose(got[k], ref_w[k], rtol=2e-4, atol=1e-6,
+                                   err_msg=f"weight {k} diverged")
+
+
+def test_auto_resume_fires_at_most_once_per_model(tmp_path):
+    """--auto-resume must not rewind live training state on a SECOND fit()
+    call in the same process (keras drives one fit per epoch): only the
+    first fit restores; later fits continue from live state."""
+    import jax
+
+    x, y = _data(32)
+    root = str(tmp_path / "ck")
+
+    ff1 = _mlp(mesh=DP8)
+    ff1.enable_checkpointing(root)
+    ff1.fit(x, y, epochs=1, batch_size=8, shuffle=True)
+    ff1._resilience.save(ff1._py_step(), cursor={"epoch": 1, "batch": 0},
+                         blocking=True)
+
+    ff2 = _mlp(mesh=DP8, argv=["--checkpoint-dir", root, "--auto-resume"])
+    ff2.fit(x, y, epochs=2, batch_size=8, shuffle=True)  # resumes: +4 steps
+    assert int(np.asarray(jax.device_get(ff2._step))) == 8
+    ff2.fit(x, y, epochs=1, batch_size=8, shuffle=True)  # must NOT rewind
+    assert int(np.asarray(jax.device_get(ff2._step))) == 12
+
+
+def test_discovery_handles_steps_past_eight_digits(tmp_path):
+    """%08d grows to 9 digits at step 1e8 — discovery, LATEST, and restore
+    ordering must keep working (long-run disk-growth/rewind guard)."""
+    from flexflow_tpu.resilience import AsyncCheckpointer, list_checkpoints
+
+    root = str(tmp_path / "ck")
+    ck = AsyncCheckpointer(root, keep=2)
+    ck.save(99_999_999, {"w": np.zeros(2, np.float32)}, blocking=True)
+    ck.save(100_000_000, {"w": np.ones(2, np.float32)}, blocking=True)
+    ck.save(100_000_001, {"w": np.ones(2, np.float32)}, blocking=True)
+    names = [os.path.basename(p) for p in list_checkpoints(root)]
+    assert names == ["step_100000000", "step_100000001"]  # pruned + sorted
+
+
+def test_repeated_fit_calls_get_fresh_shuffle_orders():
+    """The deterministic shuffle must advance across fit() calls: keras
+    Model.fit drives one FFModel.fit(epochs=1) per keras epoch, and
+    re-training one fixed order every epoch would silently degrade
+    convergence. Orders are keyed on the ABSOLUTE epoch count."""
+    ff = _mlp()
+    o0 = ff._epoch_order(32, 0, True)
+    x, y = _data(16)
+    ff.fit(x, y, epochs=1, batch_size=8, shuffle=True)  # advances the base
+    o1 = ff._epoch_order(32, 0, True)
+    assert not np.array_equal(o0, o1)
+    # and the absolute indexing is reproducible: a fresh model's epoch 1
+    # equals the trained model's post-fit epoch 0
+    ff2 = _mlp()
+    np.testing.assert_array_equal(o1, ff2._epoch_order(32, 1, True))
+
+
+def test_barrier_is_noop_single_process():
+    from flexflow_tpu.distributed import barrier
+
+    barrier("test")  # must not raise or hang
+
+
+# ===================================================================
+# async overhead (acceptance: within 10% of no-checkpoint baseline) —
+# timing-sensitive, excluded from tier-1 via the slow marker; run
+# scripts/bench_checkpoint.py for the measured number
+# ===================================================================
+
+@pytest.mark.slow
+@pytest.mark.full
+def test_async_saves_do_not_block_the_caller(tmp_path):
+    """The step loop pays only the copy-on-snapshot cost: issuing an async
+    save must return well before an equivalent blocking save completes
+    (serialize+fsync+commit moved off-thread). Same-process contrast, so
+    shared-CI load noise cancels; the quotable fit-level overhead numbers
+    (~0.2ms blocking per save, +3.5% wall-clock at --checkpoint-every 32)
+    come from scripts/bench_checkpoint.py, whose interleaved wall-clock
+    protocol needs a quiet machine."""
+    import time
+
+    from flexflow_tpu.resilience import AsyncCheckpointer
+
+    rs = np.random.RandomState(0)
+    # a realistically-sized state tree (~64MB): fsync dominates blocking
+    tree = {"params": {f"layer{i}": {"kernel": rs.randn(512, 512).astype(
+        np.float32)} for i in range(64)}}
+
+    def timed(blocking, root):
+        ck = AsyncCheckpointer(root)
+        t_issue = []
+        t0 = time.perf_counter()
+        for s in range(3):
+            ti = time.perf_counter()
+            ck.save(s, tree, blocking=blocking)
+            t_issue.append(time.perf_counter() - ti)
+        ck.wait()
+        total = time.perf_counter() - t0
+        return min(t_issue), total
+
+    t_block, _ = timed(True, str(tmp_path / "b"))
+    t_async, total_async = timed(False, str(tmp_path / "a"))
+    print(f"issue latency: blocking {t_block*1e3:.1f}ms "
+          f"vs async {t_async*1e3:.1f}ms")
+    # the async issue path skips serialize+fsync+commit entirely
+    assert t_async < t_block, (
+        f"async save issue ({t_async:.3f}s) not faster than a full "
+        f"blocking save ({t_block:.3f}s)")
+    # and the work still happened: all three checkpoints committed
+    from flexflow_tpu.resilience import list_checkpoints
+
+    assert len(list_checkpoints(str(tmp_path / "a"))) == 3
