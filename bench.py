@@ -47,6 +47,8 @@ def _measure_lm(cfg, batch: int, steps: int, warmup: int, on_tpu: bool):
     from flexflow_tpu.models import build_transformer_lm
     from flexflow_tpu.models.transformer import transformer_lm_flops_per_token
 
+    from flexflow_tpu import telemetry
+
     config = FFConfig()
     config.batch_size = batch
     if on_tpu:
@@ -56,9 +58,10 @@ def _measure_lm(cfg, batch: int, steps: int, warmup: int, on_tpu: bool):
         config.computation_dtype = DataType.DT_BFLOAT16
     ff = FFModel(config)
     build_transformer_lm(ff, cfg, batch_size=batch)
-    ff.compile(optimizer=SGDOptimizer(lr=0.01),
-               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
-    step_fn = ff.executor.build_train_step()
+    with telemetry.span("bench.compile", seq=cfg.sequence_length):
+        ff.compile(optimizer=SGDOptimizer(lr=0.01),
+                   loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        step_fn = ff.executor.build_train_step()
 
     rs = np.random.RandomState(0)
     toks = rs.randint(0, cfg.vocab_size,
@@ -104,16 +107,18 @@ def _measure_lm(cfg, batch: int, steps: int, warmup: int, on_tpu: bool):
     def sync(st):
         return int(jax.device_get(st[3]))  # step counter: forces completion
 
-    st, rng = loop(state, rng, batch_data, jnp.int32(warmup))
-    sync(st)  # compile + warm
+    with telemetry.span("bench.warmup", steps=warmup):
+        st, rng = loop(state, rng, batch_data, jnp.int32(warmup))
+        sync(st)  # compile + warm
 
     def t_of(n, st, rng):
         ts = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            st, rng = loop(st, rng, batch_data, jnp.int32(n))
-            sync(st)
-            ts.append(time.perf_counter() - t0)
+        with telemetry.span("bench.measure", steps=n):
+            for _ in range(3):
+                t0 = time.perf_counter()
+                st, rng = loop(st, rng, batch_data, jnp.int32(n))
+                sync(st)
+                ts.append(time.perf_counter() - t0)
         return statistics.median(ts), st, rng
 
     flops_per_token = transformer_lm_flops_per_token(cfg)
@@ -134,11 +139,40 @@ def _measure_lm(cfg, batch: int, steps: int, warmup: int, on_tpu: bool):
 
 
 def main():
+    # --telemetry-dir DIR: archive this run's host-side timeline + metrics
+    # (trace.json / metrics.jsonl) so BENCH numbers come with forensics.
+    # Parsed here because the harness deliberately clears argv below (the
+    # model under test must not inherit bench flags).
+    argv = sys.argv[1:]
+    telemetry_dir = None
+    if "--telemetry-dir" in argv:
+        i = argv.index("--telemetry-dir")
+        if i + 1 >= len(argv) or argv[i + 1].startswith("--"):
+            print("bench: --telemetry-dir requires a directory argument",
+                  file=sys.stderr)
+            sys.exit(2)
+        telemetry_dir = argv[i + 1]
     sys.argv = [sys.argv[0]]
     import jax
 
+    from flexflow_tpu import telemetry
     from flexflow_tpu.models import TransformerLMConfig
 
+    session = None
+    if telemetry_dir:
+        session = telemetry.activate(telemetry.TelemetrySession(telemetry_dir))
+        session.write_manifest()
+    try:
+        _bench_body(jax, TransformerLMConfig, telemetry, session)
+    finally:
+        # the timeline must survive a mid-bench crash — that is exactly
+        # when the archived trace is wanted (close() is idempotent; the
+        # success path already closed with the bench event recorded)
+        if session is not None:
+            session.close()
+
+
+def _bench_body(jax, TransformerLMConfig, telemetry, session):
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     if on_tpu:
@@ -187,27 +221,31 @@ def main():
         except Exception as e:  # pragma: no cover - defensive
             print(f"bench: long-context leg failed: {e}", file=sys.stderr)
 
+    # one payload feeds both the archived metrics record and the printed
+    # line of record — they must never drift apart
+    payload = {
+        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "value": None if tokens_per_sec is None else round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": None if tokens_per_sec is None else round(mfu / 0.35, 4),
+    }
     if tokens_per_sec is None:
         # a physically impossible reading must never become the number of
         # record: emit null and fail so the driver records the fluke as a
         # fluke instead of a result
         print("bench: all retries read >100% MFU — backend measurement "
               "fluke, result is NOT trustworthy", file=sys.stderr)
-        print(json.dumps({
-            "metric": "transformer_lm_tokens_per_sec_per_chip",
-            "value": None,
-            "unit": "tokens/s",
-            "vs_baseline": None,
-        }))
+        print(json.dumps(payload))
+        if session is not None:
+            telemetry.event("bench", fluke=True, **payload)
+            session.close()
         sys.exit(1)
+    if session is not None:
+        telemetry.event("bench", **payload)
+        session.close()
     # primary metric LAST — the driver parses the last line as the number
     # of record
-    print(json.dumps({
-        "metric": "transformer_lm_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 2),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.35, 4),
-    }))
+    print(json.dumps(payload))
     sys.stdout.flush()
 
 

@@ -32,6 +32,7 @@ from .metrics import Metrics, PerfMetrics
 from .model import FFModel
 from . import parallel  # registers parallel-op OpDefs
 from . import resilience  # checkpointing / elastic resume / preemption
+from . import telemetry  # tracer + run metrics + leveled logging
 from .parallel import Strategy
 from .optimizer import AdamOptimizer, Optimizer, SGDOptimizer
 from .tensor import ParallelDim, ParallelTensor, ParallelTensorShape, Tensor

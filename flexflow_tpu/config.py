@@ -111,6 +111,12 @@ class FFConfig:
     checkpoint_every_seconds: float = 0.0
     checkpoint_keep: int = 3
     auto_resume: bool = False
+    # observability (telemetry/): telemetry_dir enables the run-wide
+    # tracer + JSONL metrics log (trace.json / metrics.jsonl under the
+    # dir); xprof_dir additionally wraps fit in jax.profiler.trace for
+    # device-level XProf timelines (docs/observability.md)
+    telemetry_dir: str = ""
+    xprof_dir: str = ""
 
     def __post_init__(self):
         argv = sys.argv[1:]
@@ -280,6 +286,10 @@ class FFConfig:
                 self.checkpoint_keep = int(val())
             elif a == "--auto-resume":
                 self.auto_resume = True
+            elif a == "--telemetry-dir":
+                self.telemetry_dir = val()
+            elif a == "--xprof-dir":
+                self.xprof_dir = val()
             elif a == "--synthetic-input":
                 self.synthetic_input = True
             elif a == "--allow-tensor-op-math-conversion":

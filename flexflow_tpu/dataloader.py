@@ -18,6 +18,8 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding
 
+from . import telemetry
+
 
 class SingleDataLoader:
     def __init__(self, ffmodel, batch_tensor, full_array: np.ndarray):
@@ -50,18 +52,23 @@ class SingleDataLoader:
         self.next_index = idx
 
     def next_batch(self, ffmodel=None) -> np.ndarray:
-        if self.next_index + self.batch_size > self.num_samples:
-            self.next_index = 0
-        sl = slice(self.next_index, self.next_index + self.batch_size)
-        self.next_index += self.batch_size
-        return self.full_array[sl]
+        with telemetry.span("data.next_batch"):
+            if self.next_index + self.batch_size > self.num_samples:
+                self.next_index = 0
+            sl = slice(self.next_index, self.next_index + self.batch_size)
+            self.next_index += self.batch_size
+            return self.full_array[sl]
 
     def next_batch_sharded(self):
-        """Batch pre-placed on the mesh with the input's sharding."""
-        batch = self.next_batch()
-        ff = self.ffmodel
-        for node in ff.graph.sources():
-            if node.name == self.batch_tensor.name:
-                spec = node.outputs[0].partition_spec()
-                return jax.device_put(batch, NamedSharding(ff.mesh, spec))
-        return jax.device_put(batch)
+        """Batch pre-placed on the mesh with the input's sharding. The
+        data_wait span covers slice + device_put — the host-side stall a
+        training step pays before dispatch (telemetry/)."""
+        with telemetry.span("data_wait"):
+            batch = self.next_batch()
+            ff = self.ffmodel
+            for node in ff.graph.sources():
+                if node.name == self.batch_tensor.name:
+                    spec = node.outputs[0].partition_spec()
+                    return jax.device_put(
+                        batch, NamedSharding(ff.mesh, spec))
+            return jax.device_put(batch)

@@ -151,6 +151,43 @@ class ModelCheckpoint(Callback):
             self._manager.finalize()  # drain the in-flight async save
 
 
+class Telemetry(Callback):
+    """Enable the observability subsystem (telemetry/) for keras-style
+    training: Chrome-trace timeline + JSONL metrics under `directory`,
+    with one `epoch` record per keras epoch carrying the monitored
+    accuracy/loss. The callback twin of --telemetry-dir.
+
+    Artifacts are flushed at every epoch end (live tailing) and finalized
+    at train end; the session stays attached to the model, so
+    `model.ffmodel.get_telemetry()` reads it back afterwards.
+    """
+
+    def __init__(self, directory: str):
+        super().__init__()
+        self.directory = directory
+        self.session = None
+
+    def on_train_begin(self, logs=None):
+        ff = self.model.ffmodel
+        assert ff is not None, "compile() before fit with Telemetry"
+        self.session = ff.enable_telemetry(self.directory)
+        self.session.write_manifest(ff)
+
+    def on_epoch_end(self, epoch, logs=None):
+        pm = self.model.ffmodel.get_perf_metrics()
+        self.session.recorder.record(
+            "keras_epoch", epoch=int(epoch),
+            accuracy=float(pm.get_accuracy()),
+            mean_loss=float(pm.get_mean_loss()))
+        self.session.flush()
+        return False  # never early-stop training
+
+    def on_train_end(self, logs=None):
+        if self.session is not None:
+            self.session.write_summary()
+            self.session.flush()
+
+
 class VerifyMetrics(Callback):
     """Assert the final train accuracy clears a gate (AE scripts' check)."""
 

@@ -103,7 +103,7 @@ class BaseModel:
         return ff
 
     def fit(self, x, y, epochs=1, batch_size=-1, callbacks=None,
-            shuffle=True):
+            shuffle=True, verbose=True):
         """Reference base_model.py:198-376 semantics: train/epoch callback
         hooks fire around the per-epoch FFModel.fit loop; an on_epoch_end
         returning truthy stops training early (EpochVerifyMetrics)."""
@@ -125,7 +125,7 @@ class BaseModel:
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
             self.ffmodel.fit(x, y, epochs=1, batch_size=batch_size,
-                             shuffle=shuffle)
+                             shuffle=shuffle, verbose=verbose)
             # evaluate EVERY callback's hook before deciding to stop — a
             # short-circuiting any() would starve callbacks after the
             # first truthy one of their final-epoch hook

@@ -114,6 +114,7 @@ class FFModel:
         self._epoch_base = 0  # absolute epochs completed across fit() calls
         self._auto_resumed = False  # auto-resume fires at most once
         self._resume_cursor = None  # (absolute epoch, batch) to resume at
+        self._telemetry = None  # TelemetrySession (telemetry/session.py)
 
     # ================================================== tensor creation
 
@@ -614,6 +615,45 @@ class FFModel:
     ):
         """Lower layers → PCG, choose a parallelization strategy, build the
         executor (pipeline parity: model.cc:2803-3168)."""
+        from . import telemetry
+
+        if self._telemetry is None and self.config.telemetry_dir:
+            self.enable_telemetry(self.config.telemetry_dir)
+        tel = self._telemetry
+        try:
+            if tel is not None:
+                # the global sink is active only while ITS model is inside
+                # an instrumented operation — another model compiled in the
+                # same process must not write into this model's artifacts
+                telemetry.activate(tel)
+                # manifest FIRST — before any search events the body emits
+                tel.write_manifest(self)
+            t_compile0 = time.perf_counter()
+            with telemetry.span("compile"):
+                self._compile_impl(optimizer, loss_type, metrics, comp_mode)
+            if tel is not None:
+                # the COMPILED outcome (a mesh-shape search may have
+                # replaced the configured mesh; strategy_nodes = ops
+                # deviating from pure data parallel)
+                tel.recorder.record(
+                    "compile",
+                    duration_s=time.perf_counter() - t_compile0,
+                    num_nodes=len(self.graph.topo_order()),
+                    mesh_axes={k: int(v)
+                               for k, v in self.mesh.shape.items()},
+                    strategy_nodes=sorted(self._strategy)
+                    if self._strategy else [],
+                )
+        finally:
+            if tel is not None:
+                # flush in the finally: a compile/search crash is exactly
+                # when the buffered spans are wanted on disk
+                tel.flush()
+                telemetry.deactivate(tel)
+
+    def _compile_impl(self, optimizer, loss_type, metrics, comp_mode):
+        from . import telemetry
+
         self.optimizer = optimizer or SGDOptimizer(lr=self.config.learning_rate)
         self.loss_type = LossType(loss_type)
         self.metrics = Metrics.from_list(self.loss_type, list(metrics))
@@ -727,8 +767,9 @@ class FFModel:
                 # costs candidates from measurements, not the mfu guess
                 # (Simulator::measure_operator_cost, model.cu:38-75)
                 if self.config.search_calibrate > 0:
-                    cost_model.calibrate_graph(
-                        g, top_k=self.config.search_calibrate)
+                    with telemetry.span("compile.calibrate"):
+                        cost_model.calibrate_graph(
+                            g, top_k=self.config.search_calibrate)
 
             tensor_to_out[self.layers[-1].outputs[0].tensor_guid][0]._is_logits = True
             if jax.process_count() > 1:
@@ -749,7 +790,8 @@ class FFModel:
                         g, self.mesh, self.config, cost_model)
                     return us.to_strategy(choice)
 
-                self._strategy = run_search_on_host0(_search)
+                with telemetry.span("compile.search", mode="multihost"):
+                    self._strategy = run_search_on_host0(_search)
                 self._assign_strategy()
             elif self.config.search_mesh_shapes:
                 # also search the mesh factorization itself (the MachineView
@@ -789,12 +831,13 @@ class FFModel:
                     machine_factory = lambda mesh: machine_model_from_file(  # noqa: E731
                         self.config.machine_model_file, mesh)
                 _calibrate()
-                shape, g, choice, us, _ = search_mesh_shapes(
-                    g, n_devices, self.config, axes=search_axes,
-                    chip=machine.chip,
-                    num_hosts=self.config.num_nodes,
-                    calibrated=cost_model,
-                    machine_factory=machine_factory)
+                with telemetry.span("compile.search", mode="mesh_shapes"):
+                    shape, g, choice, us, _ = search_mesh_shapes(
+                        g, n_devices, self.config, axes=search_axes,
+                        chip=machine.chip,
+                        num_hosts=self.config.num_nodes,
+                        calibrated=cost_model,
+                        machine_factory=machine_factory)
                 sizes = {a: 1 for a in ms.axis_names}
                 sizes.update(shape)
                 self.mesh = build_mesh(MeshShape(
@@ -804,8 +847,9 @@ class FFModel:
                 used_substitutions = True
             else:
                 _calibrate()
-                g, choice, us = joint_graph_optimize(
-                    g, self.mesh, self.config, cost_model)
+                with telemetry.span("compile.search", mode="joint"):
+                    g, choice, us = joint_graph_optimize(
+                        g, self.mesh, self.config, cost_model)
                 self.graph = g
                 self._strategy = us.to_strategy(choice).overrides
                 used_substitutions = True
@@ -960,6 +1004,35 @@ class FFModel:
             keep=keep)
         return self._resilience
 
+    def enable_telemetry(self, directory: str):
+        """Attach the observability subsystem (telemetry/): Chrome-trace
+        spans + JSONL run metrics under `directory`. The session becomes
+        the process-wide sink only WHILE this model is inside compile/fit
+        (so search/resilience/dataloader hooks land in the same files
+        without other models leaking events in between). The programmatic
+        twin of --telemetry-dir."""
+        from . import telemetry
+        from .telemetry import log as fflog
+
+        if self._telemetry is None:
+            self._telemetry = telemetry.TelemetrySession(directory)
+        else:
+            import os
+
+            if os.path.abspath(directory) != self._telemetry.directory:
+                # e.g. --telemetry-dir A at compile + Telemetry("B")
+                # callback: the first session wins; say so instead of
+                # letting the user tail an empty directory
+                fflog.warning(
+                    "enable_telemetry(%r) ignored: this model's telemetry "
+                    "session already writes to %s",
+                    directory, self._telemetry.directory)
+        return self._telemetry
+
+    def get_telemetry(self):
+        """The model's TelemetrySession, or None when telemetry is off."""
+        return self._telemetry
+
     def _py_step(self) -> int:
         """The device step counter as a host int — THE checkpoint step
         numbering convention (fit's policy decisions, explicit saves, and
@@ -989,12 +1062,35 @@ class FFModel:
         return rs.permutation(num_samples)
 
     def fit(self, x: Union[np.ndarray, Sequence[np.ndarray], dict], y: np.ndarray,
-            epochs: int = -1, batch_size: int = -1, shuffle: bool = True):
+            epochs: int = -1, batch_size: int = -1, shuffle: bool = True,
+            verbose: bool = True):
         """Training loop (parity: flexflow_cffi.py:2058-2100), made
         preemption-safe: policy-gated async checkpoints between steps, a
         SIGTERM drain-and-final-snapshot path, and --auto-resume restart
-        from the newest committed checkpoint's (epoch, batch) cursor."""
+        from the newest committed checkpoint's (epoch, batch) cursor.
+
+        With telemetry on (--telemetry-dir / enable_telemetry) every step
+        emits a trace span and a JSONL record splitting wall time into
+        data-wait vs device time plus the blocking slice of any checkpoint
+        save; `verbose=False` drops the epoch progress lines to debug
+        level (they also honor FF_LOG_LEVEL and emit on host 0 only)."""
         assert self._compiled, "call compile() before fit()"
+        from . import telemetry
+        from .telemetry import log as fflog
+
+        if self._telemetry is None and self.config.telemetry_dir:
+            self.enable_telemetry(self.config.telemetry_dir)
+        tel = self._telemetry
+        if tel is not None:
+            # active only for the duration of THIS model's fit (the
+            # matching deactivate is in the loop's finally below) —
+            # another model training afterwards in the same process must
+            # not leak events into this model's artifacts
+            telemetry.activate(tel)
+            # idempotent: covers sessions attached after compile (keras
+            # Telemetry callback, manual enable_telemetry)
+            tel.write_manifest(self)
+        epoch_log = fflog.info if verbose else fflog.debug
         if self.config.profiling and not getattr(self, "_profiled", False):
             # --profiling: per-op kernel table, printed once per compile
             # (the reference prints per-kernel times every launch under
@@ -1043,14 +1139,23 @@ class FFModel:
                         f"this model's live progress (epoch {abs_epoch} < "
                         f"{self._epoch_base}) — ignored", stacklevel=2)
                 else:
-                    resil.restore_path(path)
+                    with telemetry.span("resume.restore", path=path):
+                        resil.restore_path(path)
                     start_epoch = abs_epoch - self._epoch_base
                     # the batch offset sticks to its ABSOLUTE epoch: when
                     # fit is driven one epoch at a time (keras), the epoch
                     # containing it may only be reached by a later call
                     self._resume_cursor = (
                         abs_epoch, int(cur.get("batch", 0)))
+                    telemetry.instant("resume", path=path, epoch=abs_epoch)
+                    telemetry.event(
+                        "resume", path=path, epoch=abs_epoch,
+                        batch=int(cur.get("batch", 0)))
         py_step = self._py_step()
+        # derived token rate: labels shaped (N, seq, ...) carry seq tokens
+        # per example (trailing size-1 dims collapse; plain (N, 1) labels
+        # degenerate to 1 token = 1 example)
+        tokens_per_example = int(np.prod(y.shape[1:])) if y.ndim > 1 else 1
 
         import contextlib
 
@@ -1062,6 +1167,12 @@ class FFModel:
         with contextlib.ExitStack() as stack:
             if preempt is not None:
                 stack.enter_context(preempt)
+            if self.config.xprof_dir:
+                # opt-in device-level timeline: the whole fit runs under
+                # jax.profiler.trace, so XProf/TensorBoard shows the XLA
+                # step right where the host-side trace shows its dispatch
+                stack.enter_context(
+                    jax.profiler.trace(self.config.xprof_dir))
             try:
                 for epoch in range(start_epoch, epochs):
                     abs_e = self._epoch_base + epoch
@@ -1083,53 +1194,72 @@ class FFModel:
                                 b0 = 0
                         self._resume_cursor = None
                     for b in range(b0, num_batches):
-                        idx = order[b * batch_size : (b + 1) * batch_size]
-                        xb = {k: v[idx] for k, v in x_dict.items()}
-                        yb = y[idx]
-                        batch = self._make_batch(xb, yb)
-                        self._rng, sub = jax.random.split(self._rng)
-                        (
-                            self._params,
-                            self._state,
-                            self._opt_slots,
-                            self._step,
-                            self._counters,
-                            lval,
-                        ) = step_fn(
-                            self._params, self._state, self._opt_slots,
-                            self._step, self._counters, sub, batch,
-                        )
-                        py_step += 1
-                        # the cursor names the NEXT batch to run on
-                        # resume; epochs are ABSOLUTE (since compile)
-                        if b + 1 >= num_batches:
-                            cursor = {"epoch": abs_e + 1, "batch": 0}
-                        else:
-                            cursor = {"epoch": abs_e, "batch": b + 1}
-                        if resil is not None:
-                            if preempt.preempted:
-                                # preemption notice: drain the in-flight
-                                # async save, then one final synchronous
-                                # snapshot — the only blocking save
-                                resil.finalize(py_step, cursor,
-                                               final_save=True)
-                                preempted = True
+                        t_it0 = time.perf_counter() if tel is not None else 0.0
+                        with telemetry.span("step", step=py_step + 1):
+                            with telemetry.span("data_wait"):
+                                idx = order[b * batch_size : (b + 1) * batch_size]
+                                xb = {k: v[idx] for k, v in x_dict.items()}
+                                yb = y[idx]
+                                batch = self._make_batch(xb, yb)
+                            data_wait = (time.perf_counter() - t_it0
+                                         if tel is not None else 0.0)
+                            self._rng, sub = jax.random.split(self._rng)
+                            (
+                                self._params,
+                                self._state,
+                                self._opt_slots,
+                                self._step,
+                                self._counters,
+                                lval,
+                            ) = step_fn(
+                                self._params, self._state, self._opt_slots,
+                                self._step, self._counters, sub, batch,
+                            )
+                            py_step += 1
+                            # the cursor names the NEXT batch to run on
+                            # resume; epochs are ABSOLUTE (since compile)
+                            if b + 1 >= num_batches:
+                                cursor = {"epoch": abs_e + 1, "batch": 0}
                             else:
-                                resil.maybe_save(py_step, cursor)
+                                cursor = {"epoch": abs_e, "batch": b + 1}
+                            t_save0 = (time.perf_counter()
+                                       if tel is not None else 0.0)
+                            if resil is not None:
+                                if preempt.preempted:
+                                    # preemption notice: drain the in-flight
+                                    # async save, then one final synchronous
+                                    # snapshot — the only blocking save
+                                    telemetry.instant("preempted",
+                                                      step=py_step)
+                                    resil.finalize(py_step, cursor,
+                                                   final_save=True)
+                                    preempted = True
+                                else:
+                                    resil.maybe_save(py_step, cursor)
+                        if tel is not None:
+                            tel.record_step(
+                                py_step, abs_e,
+                                time.perf_counter() - t_it0, data_wait,
+                                time.perf_counter() - t_save0,
+                                batch_size, tokens_per_example)
                         if self._fault_hook is not None:
                             self._fault_hook(py_step)
                         if preempted:
-                            print(f"preempted at step {py_step}: final "
-                                  f"checkpoint committed, stopping fit")
+                            telemetry.event("preempted", step=py_step)
+                            fflog.warning(
+                                "preempted at step %d: final checkpoint "
+                                "committed, stopping fit", py_step)
                             return
                     jax.block_until_ready(self._params)
                     dt = time.time() - t0
                     thru = (num_batches - b0) * batch_size / dt
-                    print(
+                    epoch_log(
                         f"epoch {epoch}: {self.get_perf_metrics()} "
                         f"ELAPSED TIME = {dt:.4f}s, "
                         f"THROUGHPUT = {thru:.2f} samples/s"
                     )
+                    telemetry.event("epoch", epoch=abs_e, duration_s=dt,
+                                    examples_per_sec=thru)
             except SimulatedPreemption:
                 # injected death: die exactly as a real kill would — no
                 # drain, no final save, and the in-flight async write must
@@ -1144,6 +1274,16 @@ class FFModel:
                 self._epoch_base += epochs
                 if resil is not None:
                     resil.finalize()
+            finally:
+                if tel is not None:
+                    # artifacts must exist however fit ends (normal return,
+                    # preemption, injected death): summary then trace dump.
+                    # The in-flight checkpoint writer was already drained
+                    # on every exit path, so no late events are lost by
+                    # deactivating here.
+                    tel.write_summary()
+                    tel.flush()
+                    telemetry.deactivate(tel)
 
     def eval(self, x, y, batch_size: int = -1):
         assert self._compiled

@@ -40,18 +40,49 @@ def profile_operators(graph) -> list[tuple[str, str, float, float]]:
     return rows
 
 
-def print_operator_profile(graph, file=None):
+def profile_operators_json(graph, rows=None) -> list[dict]:
+    """Machine-readable per-op profile: one dict per op with forward/
+    backward/total seconds, sorted by total descending (hot ops first —
+    the question the table exists to answer). Pass pre-measured `rows`
+    (profile_operators output) to avoid re-benchmarking."""
+    rows = profile_operators(graph) if rows is None else rows
+    out = [
+        {
+            "name": name,
+            "op_type": op_type,
+            "forward_s": fwd,
+            "backward_s": bwd,
+            "total_s": fwd + bwd,
+        }
+        for name, op_type, fwd, bwd in rows
+    ]
+    out.sort(key=lambda r: r["total_s"], reverse=True)
+    return out
+
+
+def print_operator_profile(graph, file=None, sort_by_total=False):
     """Reference-format per-op table (linear_kernels.cu:95-117 prints
-    '%s [Linear] forward time = %.2lfms'; this is the whole-graph sweep)."""
+    '%s [Linear] forward time = %.2lfms'; this is the whole-graph sweep).
+    `sort_by_total=True` orders hot ops first instead of topo order.
+
+    Each row is also emitted as a tracer counter event ("op_profile.<name>")
+    when a telemetry session is active, so the per-op table lands in the
+    same Perfetto file as the run timeline."""
     import sys
+
+    from . import telemetry
 
     out = file or sys.stdout
     rows = profile_operators(graph)
+    if sort_by_total:
+        rows = sorted(rows, key=lambda r: r[2] + r[3], reverse=True)
     print("per-operator profile (standalone kernels; the fused training "
           "step overlaps/fuses across ops):", file=out)
     for name, op_type, fwd, bwd in rows:
         print(f"{name} [{op_type}] forward time = {fwd * 1e3:.4f}ms, "
               f"backward time = {bwd * 1e3:.4f}ms", file=out)
+        telemetry.counter(f"op_profile.{name}", {
+            "forward_ms": fwd * 1e3, "backward_ms": bwd * 1e3})
     total_f = sum(r[2] for r in rows)
     total_b = sum(r[3] for r in rows)
     print(f"TOTAL (sum of standalone kernels) forward = "

@@ -37,10 +37,13 @@ import os
 import re
 import shutil
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
+
+from .. import telemetry
 
 _STEP_DIR = re.compile(r"^step_(\d{8,})$")  # %08d grows past 8 digits ≥1e8
 _TMP_PREFIX = ".tmp-"
@@ -199,6 +202,11 @@ class AsyncCheckpointer:
         self.last_committed: Optional[str] = None
         # test hook: called between serialization and commit (fault point)
         self._pre_commit_hook: Optional[Callable[[str], None]] = None
+        # telemetry: blocking-snapshot latency of the save in flight, and
+        # the previous commit's wall time (checkpoint staleness — the data
+        # loss window a kill right now would open)
+        self._snapshot_s = 0.0
+        self._last_commit_t: Optional[float] = None
 
     # ------------------------------------------------------------ save
 
@@ -213,7 +221,10 @@ class AsyncCheckpointer:
         the main thread runs train-step collectives would interleave
         collectives in different orders across hosts (deadlock)."""
         self.wait()  # drain previous save; raises its error if any
-        flat = snapshot_to_host(tree)
+        t_snap0 = time.perf_counter()
+        with telemetry.span("ckpt.snapshot", step=int(step)):
+            flat = snapshot_to_host(tree)
+        self._snapshot_s = time.perf_counter() - t_snap0
         extras = dict(extras or {})
         if blocking or jax.process_count() > 1:
             self._write(step, flat, extras)
@@ -241,75 +252,94 @@ class AsyncCheckpointer:
         # commit, raise after.
         tmp = None
         error: Optional[BaseException] = None
+        t_ser0 = time.perf_counter()
         if self._is_committer():
             try:
-                os.makedirs(self.root, exist_ok=True)
-                tmp = os.path.join(
-                    self.root,
-                    f"{_TMP_PREFIX}{_step_dirname(step)}-{os.getpid()}")
-                if os.path.exists(tmp):
-                    shutil.rmtree(tmp)
-                os.makedirs(tmp)
-                arrays, leaves = _encode_leaves(flat)
-                arrays_path = os.path.join(tmp, "arrays.npz")
-                with open(arrays_path, "wb") as f:
-                    np.savez(f, **arrays)
-                    f.flush()
-                    os.fsync(f.fileno())
-                manifest = {
-                    "committed": True,
-                    "step": int(step),
-                    "leaves": leaves,
-                    "extras": extras,
-                    "format_version": 1,
-                }
-                # manifest last: its presence marks a complete
-                # serialization
-                man_path = os.path.join(tmp, "manifest.json")
-                with open(man_path, "w") as f:
-                    json.dump(manifest, f)
-                    f.flush()
-                    os.fsync(f.fileno())
-                _fsync_dir(tmp)
+                with telemetry.span("ckpt.serialize", step=int(step)):
+                    os.makedirs(self.root, exist_ok=True)
+                    tmp = os.path.join(
+                        self.root,
+                        f"{_TMP_PREFIX}{_step_dirname(step)}-{os.getpid()}")
+                    if os.path.exists(tmp):
+                        shutil.rmtree(tmp)
+                    os.makedirs(tmp)
+                    arrays, leaves = _encode_leaves(flat)
+                    arrays_path = os.path.join(tmp, "arrays.npz")
+                    with open(arrays_path, "wb") as f:
+                        np.savez(f, **arrays)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    manifest = {
+                        "committed": True,
+                        "step": int(step),
+                        "leaves": leaves,
+                        "extras": extras,
+                        "format_version": 1,
+                    }
+                    # manifest last: its presence marks a complete
+                    # serialization
+                    man_path = os.path.join(tmp, "manifest.json")
+                    with open(man_path, "w") as f:
+                        json.dump(manifest, f)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    _fsync_dir(tmp)
                 if self._pre_commit_hook is not None:
                     self._pre_commit_hook(tmp)
             except BaseException as e:
                 error = e
+        serialize_s = time.perf_counter() - t_ser0
         # serialization done before any process may treat the checkpoint
         # as durable; host 0 alone renames (concurrent renames on a shared
         # filesystem must not collide)
-        self._barrier("ckpt-precommit")
-        skip = error is not None or self._aborted.is_set()
-        if self._is_committer() and not skip:
-            displaced = None
-            if os.path.exists(final):
-                # re-saving an existing step: move the old committed dir
-                # aside with an atomic rename FIRST — an rmtree+rename pair
-                # would open a window where a kill leaves no committed
-                # checkpoint at this step at all. .old-* names never match
-                # discovery, so a crash mid-swap still shows exactly one
-                # committed state.
-                displaced = os.path.join(
-                    self.root,
-                    f".old-{_step_dirname(step)}-{os.getpid()}")
-                if os.path.exists(displaced):
-                    shutil.rmtree(displaced)
-                os.replace(final, displaced)
-            os.replace(tmp, final)  # THE commit point
-            _fsync_dir(self.root)
-            if displaced is not None:
-                shutil.rmtree(displaced, ignore_errors=True)
-            self._write_latest(final)
-            self._prune()
-        elif skip and tmp is not None:
-            # failed or aborted (simulated death): never commit; leave no
-            # half-written state behind
-            shutil.rmtree(tmp, ignore_errors=True)
-        self._barrier("ckpt-postcommit")
+        t_commit0 = time.perf_counter()
+        with telemetry.span("ckpt.commit", step=int(step)):
+            self._barrier("ckpt-precommit")
+            skip = error is not None or self._aborted.is_set()
+            if self._is_committer() and not skip:
+                displaced = None
+                if os.path.exists(final):
+                    # re-saving an existing step: move the old committed dir
+                    # aside with an atomic rename FIRST — an rmtree+rename
+                    # pair would open a window where a kill leaves no
+                    # committed checkpoint at this step at all. .old-* names
+                    # never match discovery, so a crash mid-swap still shows
+                    # exactly one committed state.
+                    displaced = os.path.join(
+                        self.root,
+                        f".old-{_step_dirname(step)}-{os.getpid()}")
+                    if os.path.exists(displaced):
+                        shutil.rmtree(displaced)
+                    os.replace(final, displaced)
+                os.replace(tmp, final)  # THE commit point
+                _fsync_dir(self.root)
+                if displaced is not None:
+                    shutil.rmtree(displaced, ignore_errors=True)
+                self._write_latest(final)
+                self._prune()
+            elif skip and tmp is not None:
+                # failed or aborted (simulated death): never commit; leave
+                # no half-written state behind
+                shutil.rmtree(tmp, ignore_errors=True)
+            self._barrier("ckpt-postcommit")
         if error is not None:
             raise error
         if not skip:
             self.last_committed = final
+            now = time.monotonic()
+            staleness = (now - self._last_commit_t
+                         if self._last_commit_t is not None else 0.0)
+            self._last_commit_t = now
+            if telemetry.active_session() is not None:
+                # guarded: the bytes sum walks every state leaf — wasted
+                # work on the (default) telemetry-off path
+                telemetry.event(
+                    "checkpoint", step=int(step),
+                    snapshot_s=self._snapshot_s, serialize_s=serialize_s,
+                    commit_s=time.perf_counter() - t_commit0,
+                    bytes=int(sum(np.asarray(v).nbytes
+                                  for v in flat.values())),
+                    staleness_s=staleness)
 
     def _write_latest(self, final: str):
         tmp = os.path.join(self.root, ".LATEST.tmp")

@@ -96,7 +96,16 @@ class ResilienceManager:
     def restore_path(self, path: str) -> dict:
         """Restore one committed checkpoint dir (resharding onto this
         model's mesh/Strategy); returns its extras."""
-        return restore_model(self.ffmodel, path)
+        import time
+
+        from .. import telemetry
+
+        t0 = time.perf_counter()
+        with telemetry.span("ckpt.restore", path=path):
+            extras = restore_model(self.ffmodel, path)
+        telemetry.event("restore", path=path,
+                        duration_s=time.perf_counter() - t0)
+        return extras
 
     def restore_latest(self) -> Optional[dict]:
         """Restore the newest committed checkpoint (resharding onto this

@@ -335,6 +335,7 @@ def joint_graph_optimize(
         cost0 = us0._memory_penalized(t0, m0)
         if cost0 < best_cost:
             best_g, best_choice, us = graph, choice0, us0
+            best_cost = cost0
     if config.perform_memory_search:
         _, mem_f = us.evaluate(best_choice)
         if mem_f > cm.machine.chip.hbm_bytes:
@@ -348,4 +349,15 @@ def joint_graph_optimize(
                     pinned=derive_pinned_configs(best_g, mesh)),
                 cm.machine.chip.hbm_bytes)
     apply_choice_to_graph(best_g, mesh, best_choice)
+    if _depth == 0:
+        # one summary record per top-level search (recursive sequence-split
+        # halves report through the shared best_first_search events);
+        # guarded so the disabled path pays no extra topo_order()
+        from .. import telemetry
+
+        if telemetry.active_session() is not None:
+            telemetry.event(
+                "search", evals=us.evals, cache_hits=us.cache_hits,
+                best_cost_s=best_cost, rewritten=best_g is not graph,
+                nodes=len(best_g.topo_order()))
     return best_g, best_choice, us

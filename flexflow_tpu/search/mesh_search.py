@@ -94,6 +94,7 @@ def search_mesh_shapes(
     replaced per candidate. `machine_factory(mesh) -> TPUMachineModel`
     overrides the analytic default (e.g. machine_model_from_file, so the
     file's topology/congestion fidelity survives the shape search)."""
+    from .. import telemetry
     from .joint import joint_graph_optimize
 
     best = None
@@ -109,20 +110,31 @@ def search_mesh_shapes(
         if calibrated is not None:
             cm._calibration = calibrated._calibration
         g = clone_graph(graph)
+        shape_label = ",".join(f"{a}={d}" for a, d in sizes.items())
         try:
-            g, choice, us = joint_graph_optimize(g, mesh, config, cm)
+            with telemetry.span("mesh_search.candidate", shape=shape_label):
+                g, choice, us = joint_graph_optimize(g, mesh, config, cm)
         except ValueError as e:
             # a factorization the graph cannot shard onto (e.g. batch not
             # divisible): skip it rather than abort the search — but keep
             # the reason, so an every-candidate failure (a search bug, not
             # an unshardable graph) surfaces with diagnostics
             skipped.append((dict(sizes), str(e)))
+            telemetry.event("mesh_candidate", shape=dict(sizes),
+                            skipped=str(e))
             continue
         t, mem = us.evaluate(choice)
         cost = us._memory_penalized(t, mem)
         results.append((dict(sizes), cost))
         if best is None or cost < best[4]:
             best = (dict(sizes), g, choice, us, cost)
+        # per-candidate record: cost + running best — the mesh-shape half
+        # of the best-cost-so-far curve
+        telemetry.event("mesh_candidate", shape=dict(sizes), cost_s=cost,
+                        best_cost_s=best[4], evals=us.evals,
+                        cache_hits=us.cache_hits)
+        telemetry.counter("mesh_search.best_cost_ms",
+                          {"cost": best[4] * 1e3})
     if best is None:
         detail = "; ".join(f"{s}: {r}" for s, r in skipped[:4])
         raise ValueError(

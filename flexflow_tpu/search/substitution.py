@@ -1373,35 +1373,46 @@ def best_first_search(
     to reject a candidate. Returns (best graph, best cost, best payload).
     Shared by the degree-priced substitution search and the joint search
     (which prices candidates with the placement DP)."""
+    from .. import telemetry
+
     counter = itertools.count()
     best_cost, best_payload = cost_fn(graph)
     best_g = graph
     pq: list = [(best_cost, next(counter), graph)]
     seen = {graph.hash()}
     pops = 0
-    while pq and pops < budget:
-        cost, _, g = heapq.heappop(pq)
-        pops += 1
-        if cost > best_cost * alpha:
-            continue
-        for xfer in xfers:
-            for m in xfer.find_matches(g):
-                try:
-                    ng = xfer.apply(g, m)
-                except ValueError:
-                    continue
-                h = ng.hash()
-                if h in seen:
-                    continue
-                seen.add(h)
-                try:
-                    nc, npayload = cost_fn(ng)
-                except ValueError:
-                    continue
-                if nc < best_cost:
-                    best_g, best_cost, best_payload = ng, nc, npayload
-                if nc < best_cost * alpha:
-                    heapq.heappush(pq, (nc, next(counter), ng))
+    evaluated = 0
+    with telemetry.span("search.best_first", budget=budget):
+        while pq and pops < budget:
+            cost, _, g = heapq.heappop(pq)
+            pops += 1
+            if cost > best_cost * alpha:
+                continue
+            for xfer in xfers:
+                for m in xfer.find_matches(g):
+                    try:
+                        ng = xfer.apply(g, m)
+                    except ValueError:
+                        continue
+                    h = ng.hash()
+                    if h in seen:
+                        continue
+                    seen.add(h)
+                    try:
+                        nc, npayload = cost_fn(ng)
+                    except ValueError:
+                        continue
+                    evaluated += 1
+                    if nc < best_cost:
+                        best_g, best_cost, best_payload = ng, nc, npayload
+                        # best-cost-so-far curve across rewritten candidates
+                        telemetry.counter(
+                            "search.best_cost_ms",
+                            {"cost": best_cost * 1e3})
+                    if nc < best_cost * alpha:
+                        heapq.heappush(pq, (nc, next(counter), ng))
+    telemetry.event("search_candidates", candidates=evaluated, pops=pops,
+                    best_cost_s=best_cost)
     return best_g, best_cost, best_payload
 
 

@@ -106,6 +106,7 @@ class UnitySearch:
         self._segment_cache: dict = (segment_cache if segment_cache
                                      is not None else {})
         self.cache_hits = 0
+        self.evals = 0  # evaluate() calls — the search-effort telemetry
 
     # ---------------------------------------------------- candidate configs
 
@@ -278,6 +279,7 @@ class UnitySearch:
         branches (DLRM towers) are priced at max(paths). `only` restricts
         accumulation to a guid subset (segment costing): configs outside it
         still feed reshard classification but don't contribute cost."""
+        self.evals += 1
         acc = _MakespanAccum(
             overlap_sync=self.config.search_overlap_backward_update)
         mem = 0.0
@@ -446,6 +448,15 @@ class UnitySearch:
         transformer blocks (and unchanged segments across rewritten
         candidate graphs) hit the cache. Best-first refinement afterwards
         (base_optimize analog). Returns {guid -> NodeConfig}."""
+        from .. import telemetry
+
+        with telemetry.span("unity.dp", nodes=len(self.order)):
+            choice = self._run_dp()
+        telemetry.counter("unity.search_effort", {
+            "evals": self.evals, "cache_hits": self.cache_hits})
+        return choice
+
+    def _run_dp(self) -> dict:
         segments = self._split_segments()
         if len(segments) <= 1:
             choice: dict = {}
@@ -627,6 +638,8 @@ class UnitySearch:
 
     def _refine(self, choice: dict) -> dict:
         """Budgeted best-first single-node moves (base_optimize analog)."""
+        from .. import telemetry
+
         budget = self.config.search_budget or 8
         alpha = self.config.search_alpha
         best = dict(choice)
@@ -653,6 +666,11 @@ class UnitySearch:
                     if cost < best_cost:
                         best, best_cost = cand, cost
                         frontier.append(cand)
+                        # best-cost-so-far curve: one counter sample per
+                        # improvement, visible as a descending staircase
+                        telemetry.counter(
+                            "unity.best_cost_ms",
+                            {"cost": best_cost * 1e3})
                     elif cost < best_cost * alpha:
                         frontier.append(cand)
         return best

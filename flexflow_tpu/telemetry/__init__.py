@@ -1,0 +1,98 @@
+"""Run-wide observability: tracer spans, structured metrics, leveled logs.
+
+Three coordinated pieces (docs/observability.md):
+
+1. **Tracer** (tracer.py) — host-side span/counter/instant events dumped as
+   Chrome trace-event JSON (Perfetto / chrome://tracing), plus an opt-in
+   `jax.profiler.trace` passthrough (`--xprof-dir`) for device timelines.
+2. **MetricsRecorder** (recorder.py) — JSONL event log with a run manifest
+   and derived rates; `summary` record carries p50/p95 step time.
+3. **Instrumentation hooks** — model compile/fit, search/, resilience/,
+   dataloader call the module-level `span`/`instant`/`counter`/`event`
+   helpers below. They dispatch to the ACTIVE session when one exists and
+   cost one global read + one `is None` test when telemetry is off, so the
+   hooks can live permanently in hot paths.
+
+Enable with `--telemetry-dir DIR` (FFConfig), `model.enable_telemetry(DIR)`,
+or the keras `Telemetry` callback; read back via `model.get_telemetry()`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import log  # noqa: F401  (flexflow_tpu.telemetry.log)
+from .recorder import MetricsRecorder, read_jsonl
+from .session import TelemetrySession
+from .tracer import Tracer
+
+__all__ = [
+    "Tracer", "MetricsRecorder", "TelemetrySession", "read_jsonl", "log",
+    "activate", "deactivate", "active_session",
+    "span", "instant", "counter", "event",
+]
+
+_active: Optional[TelemetrySession] = None
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager — the entire cost of a disabled
+    `with telemetry.span(...)` block is returning this singleton."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+def activate(session: TelemetrySession) -> TelemetrySession:
+    """Install `session` as the process-wide telemetry sink."""
+    global _active
+    _active = session
+    return session
+
+
+def deactivate(session: Optional[TelemetrySession] = None):
+    """Remove the active session (or only `session`, if it is active)."""
+    global _active
+    if session is None or _active is session:
+        _active = None
+
+
+def active_session() -> Optional[TelemetrySession]:
+    return _active
+
+
+# ---------------------------------------------------------------- dispatch
+# Hot-path helpers: cheap no-ops when no session is active.
+
+def span(name: str, **args):
+    s = _active
+    if s is None:
+        return _NOOP
+    return s.tracer.span(name, **args)
+
+
+def instant(name: str, **args):
+    s = _active
+    if s is not None:
+        s.tracer.instant(name, **args)
+
+
+def counter(name: str, values: dict):
+    s = _active
+    if s is not None:
+        s.tracer.counter(name, values)
+
+
+def event(kind: str, **fields):
+    """Structured JSONL record into the active session's metrics log."""
+    s = _active
+    if s is not None:
+        s.recorder.record(kind, **fields)

@@ -1,0 +1,86 @@
+"""Structured JSONL run-metrics log.
+
+One JSON object per line; the first record of a run is the manifest (mesh
+shape, config snapshot, git sha), then one record per
+step/epoch/save/compile/search event, plus `summary` records with
+percentile step times and throughput — the machine-readable counterpart of
+the epoch print lines, following CheckFreq's "measure the save pipeline to
+tune it" (PAPERS.md, FAST '21). Summaries are CUMULATIVE snapshots (one
+per fit() call); consumers take the last one as the run's numbers.
+
+Schema (stable fields; producers may add more):
+  every record: {"kind": str, "t": unix seconds}
+  manifest:   mesh_axes, config, git_sha, jax_backend, process_index
+  compile:    duration_s, num_nodes, searched
+  step:       step, epoch, step_time_s, data_wait_s, save_latency_s, ema_step_time_s
+  epoch:      epoch, duration_s, examples_per_sec
+  checkpoint: step, serialize_s, commit_s, bytes, staleness_s
+  search:     evals, cache_hits, best_cost_s
+  summary:    steps, p50_step_time_s, p95_step_time_s, examples_per_sec
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+from typing import Any, Optional
+
+
+def git_sha(repo_dir: Optional[str] = None) -> str:
+    """Best-effort short sha of the enclosing repo ('' when unavailable)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=repo_dir or os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5)
+        return out.stdout.strip() if out.returncode == 0 else ""
+    except Exception:
+        return ""
+
+
+class MetricsRecorder:
+    """Append-only JSONL writer; one flush per record keeps the log live
+    (a preempted run's partial log is still readable up to the kill)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a")
+
+    def record(self, kind: str, **fields: Any):
+        rec = {"kind": kind, "t": time.time()}
+        rec.update(fields)
+        line = json.dumps(rec, default=_json_default)
+        with self._lock:
+            if self._f.closed:  # late writer-thread event after close
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self):
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+def _json_default(o):
+    """Tolerate numpy scalars and other simple objects in fields."""
+    try:
+        return float(o)
+    except Exception:
+        return repr(o)
+
+
+def read_jsonl(path: str) -> list[dict]:
+    """Parse a metrics log back into records (validation / tests / CI)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

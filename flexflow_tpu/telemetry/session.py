@@ -1,0 +1,158 @@
+"""TelemetrySession: one run's Tracer + MetricsRecorder under a directory.
+
+Artifacts under `--telemetry-dir`:
+
+    <dir>/trace.json      Chrome trace-event JSON (Perfetto / chrome://tracing)
+    <dir>/metrics.jsonl   structured run metrics (recorder.py schema)
+
+The session owns the step-time accounting (EMA, percentile summary,
+examples/sec) so the fit loop only reports raw timings. `flush()` rewrites
+trace.json from the tracer buffer — called at the end of every fit (and on
+preemption), so artifacts exist the moment training stops for any reason.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from .recorder import MetricsRecorder, git_sha
+from .tracer import Tracer
+
+
+# FFConfig fields worth reproducing a run from; everything else is either
+# derived or irrelevant to performance forensics.
+_MANIFEST_CONFIG_FIELDS = (
+    "epochs", "batch_size", "learning_rate", "num_nodes",
+    "workers_per_node", "search_budget", "search_calibrate",
+    "search_mesh_shapes", "only_data_parallel", "enable_substitutions",
+    "profiling", "computation_dtype", "checkpoint_dir", "checkpoint_every",
+    "checkpoint_every_seconds", "auto_resume", "seed",
+)
+
+
+class TelemetrySession:
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.tracer = Tracer()
+        self.recorder = MetricsRecorder(
+            os.path.join(self.directory, "metrics.jsonl"))
+        self.trace_path = os.path.join(self.directory, "trace.json")
+        self._manifest_written = False
+        # step accounting
+        self._step_times: list[float] = []
+        self._ema: Optional[float] = None
+        self._examples = 0
+        self._tokens = 0
+        self._train_seconds = 0.0
+        self._last_summary_steps = -1
+        self._closed = False
+
+    # ------------------------------------------------------------ manifest
+
+    def write_manifest(self, model=None):
+        """First record of the log: everything needed to interpret the
+        numbers (mesh, strategy, config, git sha). Idempotent — a second
+        compile on the same session records a fresh manifest only if the
+        first one never happened."""
+        if self._manifest_written:
+            return
+        self._manifest_written = True
+        fields: dict = {"git_sha": git_sha()}
+        try:
+            import jax
+
+            fields["jax_backend"] = jax.default_backend()
+            fields["process_index"] = jax.process_index()
+            fields["process_count"] = jax.process_count()
+        except Exception:
+            pass
+        if model is not None:
+            mesh = getattr(model, "mesh", None)
+            cfg = getattr(model, "config", None)
+            if mesh is not None:
+                fields["mesh_axes"] = {
+                    k: int(v) for k, v in mesh.shape.items()}
+            elif cfg is not None:
+                # pre-compile (the manifest leads even search events): the
+                # CONFIGURED mesh; a mesh-shape search's winner lands in
+                # the compile record
+                ms = cfg.mesh_shape()
+                fields["mesh_axes"] = {
+                    a: int(s) for a, s in zip(ms.axis_names, ms.axis_sizes)}
+            if cfg is not None:
+                fields["config"] = {
+                    k: _plain(getattr(cfg, k, None))
+                    for k in _MANIFEST_CONFIG_FIELDS
+                }
+        self.recorder.record("manifest", **fields)
+
+    # ------------------------------------------------------------ steps
+
+    def record_step(self, step: int, epoch: int, step_time: float,
+                    data_wait: float, save_latency: float,
+                    batch_size: int, tokens_per_example: int = 1):
+        """One optimizer step's host-side timing split. `step_time` is
+        wall-clock between step dispatches — with one step in flight it
+        converges to true device step time under backpressure."""
+        self._step_times.append(step_time)
+        self._ema = (step_time if self._ema is None
+                     else 0.9 * self._ema + 0.1 * step_time)
+        self._examples += batch_size
+        self._tokens += batch_size * tokens_per_example
+        self._train_seconds += step_time
+        self.recorder.record(
+            "step", step=int(step), epoch=int(epoch),
+            step_time_s=step_time, data_wait_s=data_wait,
+            save_latency_s=save_latency,
+            device_time_s=max(0.0, step_time - data_wait - save_latency),
+            ema_step_time_s=self._ema)
+
+    def write_summary(self):
+        """Cumulative percentile summary over every step recorded so far.
+        Each fit() call writes one on exit, so consumers take the LAST
+        summary record as the run's numbers; a call with no new steps
+        since the previous summary writes nothing (no duplicates from
+        e.g. the keras Telemetry callback's train-end)."""
+        if not self._step_times or len(self._step_times) == self._last_summary_steps:
+            return
+        self._last_summary_steps = len(self._step_times)
+        import numpy as np
+
+        ts = np.asarray(self._step_times)
+        fields = {
+            "steps": int(len(ts)),
+            "p50_step_time_s": float(np.percentile(ts, 50)),
+            "p95_step_time_s": float(np.percentile(ts, 95)),
+            "mean_step_time_s": float(ts.mean()),
+            "examples_per_sec": (self._examples / self._train_seconds
+                                 if self._train_seconds > 0 else 0.0),
+        }
+        if self._tokens > self._examples:
+            fields["tokens_per_sec"] = (
+                self._tokens / self._train_seconds
+                if self._train_seconds > 0 else 0.0)
+        self.recorder.record("summary", **fields)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def flush(self):
+        """Persist the trace buffer; the JSONL is already on disk."""
+        if not self._closed:
+            self.tracer.dump(self.trace_path)
+
+    def close(self):
+        if self._closed:
+            return
+        self.flush()
+        self.recorder.close()
+        self._closed = True
+
+
+def _plain(v):
+    """Manifest values must be JSON-native."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return str(v)
