@@ -117,6 +117,17 @@ class FFConfig:
     # device-level XProf timelines (docs/observability.md)
     telemetry_dir: str = ""
     xprof_dir: str = ""
+    # diagnostics (diagnostics/): strategy explain report at compile,
+    # online cost-model drift monitoring and run-health anomaly rules
+    # during fit. Requires telemetry (the artifacts live in its dir).
+    # drift_threshold is the EMA of |measured − predicted| / predicted
+    # device step time above which a costmodel.drift advisory fires;
+    # health_abort_on lists rule names ("nan_loss", "step_spike",
+    # "data_wait_stall", "ckpt_stale") whose alerts abort training instead
+    # of warning.
+    diagnostics: bool = False
+    drift_threshold: float = 0.5
+    health_abort_on: tuple[str, ...] = ()
 
     def __post_init__(self):
         argv = sys.argv[1:]
@@ -290,6 +301,13 @@ class FFConfig:
                 self.telemetry_dir = val()
             elif a == "--xprof-dir":
                 self.xprof_dir = val()
+            elif a == "--diagnostics":
+                self.diagnostics = True
+            elif a == "--drift-threshold":
+                self.drift_threshold = float(val())
+            elif a == "--health-abort-on":
+                self.health_abort_on = tuple(
+                    r.strip() for r in val().split(",") if r.strip())
             elif a == "--synthetic-input":
                 self.synthetic_input = True
             elif a == "--allow-tensor-op-math-conversion":

@@ -1,0 +1,235 @@
+"""Run doctor: post-mortem report from a telemetry directory.
+
+Reads whatever a run left behind — metrics.jsonl (tolerant of a torn final
+line), alerts.jsonl, strategy_report.json, trace.json — and renders one
+markdown report answering the post-mortem questions in order: did the run
+die (alerts), was it slow (step/percentile stats + top trace spans), did
+the input pipeline stall (data-wait fraction), did the cost model drift
+(predicted vs measured), and is the trace complete (dropped events).
+
+`scripts/run_doctor.py` is the CLI; `diagnose()` returns the structured
+findings so tests and tooling can assert on them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ..telemetry.recorder import read_jsonl
+
+
+def _load_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _load_jsonl(path: str) -> list[dict]:
+    try:
+        return read_jsonl(path)
+    except OSError:
+        return []
+    except json.JSONDecodeError:
+        # read_jsonl tolerates only a torn FINAL line; the doctor's job is
+        # to explain damaged runs, so mid-file corruption degrades to
+        # "every record that still parses" instead of crashing
+        out = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        except OSError:
+            pass
+        return out
+
+
+def diagnose(directory: str) -> dict:
+    """Structured post-mortem of one telemetry dir. Every section is
+    present (possibly empty) so renderers/tests need no existence
+    checks."""
+    directory = os.path.abspath(directory)
+    metrics = _load_jsonl(os.path.join(directory, "metrics.jsonl"))
+    alerts = _load_jsonl(os.path.join(directory, "alerts.jsonl"))
+    report = _load_json(os.path.join(directory, "strategy_report.json"))
+    trace = _load_json(os.path.join(directory, "trace.json"))
+
+    by_kind: dict[str, list[dict]] = {}
+    for r in metrics:
+        by_kind.setdefault(r.get("kind", "?"), []).append(r)
+    manifest = (by_kind.get("manifest") or [{}])[0]
+    steps = by_kind.get("step", [])
+    summary = (by_kind.get("summary") or [None])[-1]
+    checkpoints = by_kind.get("checkpoint", [])
+    searches = by_kind.get("search", [])
+    compiles = by_kind.get("compile", [])
+
+    data_wait_frac = None
+    if steps:
+        tot = sum(s.get("step_time_s", 0.0) for s in steps)
+        if tot > 0:
+            data_wait_frac = (
+                sum(s.get("data_wait_s", 0.0) for s in steps) / tot)
+
+    drift = None
+    if report is not None and steps:
+        predicted = report.get("total_predicted_s")
+        measured = [s.get("device_time_s") for s in steps[1:]
+                    if s.get("device_time_s")]
+        if predicted and measured:
+            mean_meas = sum(measured) / len(measured)
+            drift = {
+                "predicted_s": predicted,
+                "mean_measured_s": mean_meas,
+                "error": abs(mean_meas - predicted) / predicted,
+            }
+
+    spans: dict[str, dict] = {}
+    dropped_events = 0
+    if trace is not None:
+        for e in trace.get("traceEvents", []):
+            if e.get("ph") == "X":
+                s = spans.setdefault(e["name"], {"count": 0, "total_us": 0.0})
+                s["count"] += 1
+                s["total_us"] += e.get("dur", 0.0)
+            elif e.get("name") == "tracer.dropped_events":
+                dropped_events = int(e.get("args", {}).get("dropped", 0))
+
+    preempted = bool(by_kind.get("preempted"))
+    resumed = bool(by_kind.get("resume"))
+    errors = [a for a in alerts if a.get("level") == "error"]
+    aborted = any(a.get("action") == "abort" for a in alerts)
+    if aborted or errors:
+        verdict = "dead"
+    elif preempted:
+        verdict = "preempted"
+    elif alerts:
+        verdict = "degraded"
+    elif steps:
+        verdict = "healthy"
+    else:
+        verdict = "no-steps"
+
+    return {
+        "directory": directory,
+        "verdict": verdict,
+        "manifest": manifest,
+        "compile": (compiles or [None])[-1],
+        "search": (searches or [None])[-1],
+        "steps": len(steps),
+        "summary": summary,
+        "data_wait_frac": data_wait_frac,
+        "alerts": alerts,
+        "drift": drift,
+        "checkpoints": {
+            "count": len(checkpoints),
+            "last_staleness_s": (checkpoints[-1].get("staleness_s")
+                                 if checkpoints else None),
+            "total_bytes": sum(c.get("bytes", 0) for c in checkpoints),
+        },
+        "preempted": preempted,
+        "resumed": resumed,
+        "trace_spans": spans,
+        "trace_dropped_events": dropped_events,
+        "strategy_report": report,
+    }
+
+
+def render(d: dict) -> str:
+    """Markdown post-mortem from a diagnose() result."""
+    lines = [f"# Run doctor — `{d['directory']}`", "",
+             f"**Verdict: {d['verdict'].upper()}**", ""]
+    man = d["manifest"]
+    if man:
+        mesh = man.get("mesh_axes") or {}
+        lines.append(
+            "- mesh: `" + ", ".join(f"{k}={v}" for k, v in mesh.items())
+            + f"`  ·  backend: {man.get('jax_backend', '?')}"
+            + f"  ·  git: {man.get('git_sha', '?') or '?'}")
+    if d["compile"]:
+        lines.append(f"- compile: {d['compile'].get('duration_s', 0):.2f}s, "
+                     f"{d['compile'].get('num_nodes', '?')} nodes")
+    if d["search"]:
+        s = d["search"]
+        lines.append(f"- search: {s.get('evals', '?')} evals, "
+                     f"best cost {s.get('best_cost_s', 0) * 1e3:.3f} ms, "
+                     f"rewritten={s.get('rewritten')}")
+    summ = d["summary"]
+    if summ:
+        lines.append(
+            f"- steps: {d['steps']}  ·  p50 "
+            f"{summ.get('p50_step_time_s', 0) * 1e3:.2f} ms  ·  p95 "
+            f"{summ.get('p95_step_time_s', 0) * 1e3:.2f} ms  ·  "
+            f"{summ.get('examples_per_sec', 0):.1f} examples/s")
+    if d["data_wait_frac"] is not None:
+        lines.append(f"- data-wait fraction: {d['data_wait_frac']:.1%}")
+    ck = d["checkpoints"]
+    if ck["count"]:
+        lines.append(
+            f"- checkpoints: {ck['count']} "
+            f"({ck['total_bytes'] / 2**20:.1f} MiB total, last staleness "
+            f"{(ck['last_staleness_s'] or 0):.1f}s)")
+    if d["preempted"]:
+        lines.append("- run was PREEMPTED (final snapshot committed)")
+    if d["resumed"]:
+        lines.append("- run auto-resumed from a checkpoint")
+    if d["trace_dropped_events"]:
+        lines.append(f"- ⚠ trace TRUNCATED: {d['trace_dropped_events']} "
+                     f"events dropped at the buffer cap")
+
+    lines += ["", "## Alerts", ""]
+    if d["alerts"]:
+        lines += ["| rule | level | step | action | message |",
+                  "|---|---|---|---|---|"]
+        for a in d["alerts"]:
+            lines.append(
+                f"| {a.get('rule')} | {a.get('level')} | {a.get('step')} "
+                f"| {a.get('action', 'warn')} | {a.get('message')} |")
+    else:
+        lines.append("none")
+
+    if d["drift"]:
+        dr = d["drift"]
+        lines += ["", "## Cost-model drift", "",
+                  f"- predicted step makespan: "
+                  f"{dr['predicted_s'] * 1e3:.3f} ms",
+                  f"- mean measured device time: "
+                  f"{dr['mean_measured_s'] * 1e3:.3f} ms",
+                  f"- relative error: {dr['error']:.2f}"]
+
+    rep = d["strategy_report"]
+    if rep:
+        lines += ["", "## Strategy (top ops by predicted cost)", "",
+                  "| op | config | compute (ms) | comm (ms) |",
+                  "|---|---|---|---|"]
+        ranked = sorted(rep.get("ops", []),
+                        key=lambda o: -(o["compute_s"] + o["comm_s"]))[:8]
+        for o in ranked:
+            lines.append(f"| {o['name']} | {o['config']} "
+                         f"| {o['compute_s'] * 1e3:.3f} "
+                         f"| {o['comm_s'] * 1e3:.3f} |")
+        if rep.get("runner_ups"):
+            r0 = rep["runner_ups"][0]
+            lines.append(
+                f"\nchosen plan beat `{r0['label']}` by "
+                f"{r0['margin_s'] * 1e3:.3f} ms")
+
+    if d["trace_spans"]:
+        lines += ["", "## Where the time went (host spans)", "",
+                  "| span | count | total (ms) |", "|---|---|---|"]
+        ranked = sorted(d["trace_spans"].items(),
+                        key=lambda kv: -kv[1]["total_us"])[:10]
+        for name, s in ranked:
+            lines.append(f"| {name} | {s['count']} "
+                         f"| {s['total_us'] / 1e3:.2f} |")
+    lines.append("")
+    return "\n".join(lines)

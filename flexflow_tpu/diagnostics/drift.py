@@ -1,0 +1,150 @@
+"""Online cost-model drift monitoring.
+
+FlexFlow earns trust in its search by measuring ops on the real device;
+this module keeps checking that trust *during training*: each step's
+measured device time (metrics.jsonl already splits it out of wall time) is
+compared against the search's predicted step makespan, an EMA of the
+relative prediction error is maintained, every sample lands in the
+telemetry trace as a `costmodel.drift` counter, and when the EMA crosses
+the threshold a structured advisory fires — once per sustained excursion —
+which can drive `recompile.RecompileState` re-calibration
+(`make_recalibration_state` builds the canonical one).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class DriftAdvisory:
+    """Structured drift advisory (also serialized into alerts.jsonl)."""
+
+    step: int
+    predicted_s: float
+    measured_ema_s: float
+    error_ema: float        # EMA of |measured − predicted| / predicted
+    threshold: float
+    message: str = ""
+
+    def to_record(self) -> dict:
+        return {
+            "rule": "costmodel_drift", "level": "warning",
+            "step": int(self.step),
+            "predicted_s": float(self.predicted_s),
+            "measured_ema_s": float(self.measured_ema_s),
+            "error_ema": float(self.error_ema),
+            "threshold": float(self.threshold),
+            "message": self.message,
+        }
+
+
+class DriftMonitor:
+    """EMA drift detector over per-step (predicted, measured) pairs.
+
+    - warmup: the first `warmup` samples only feed the EMA (step 1 carries
+      jit compile; early EMAs are noise);
+    - hysteresis: after an advisory the monitor re-arms only when the EMA
+      falls back under threshold/2 (or after a recalibration resets the
+      prediction), so a sustained excursion yields ONE advisory, not one
+      per step;
+    - `recompile_state`: an optional recompile.RecompileState whose
+      trigger/alter pair runs when an advisory fires — the reference's
+      dynamic re-optimization hook (recompile_state.cc) pointed at
+      cost-model re-calibration.
+    """
+
+    def __init__(self, predicted_s: float, threshold: float = 0.5,
+                 warmup: int = 5, ema_alpha: float = 0.2,
+                 recompile_state=None):
+        self.predicted_s = float(predicted_s)
+        self.threshold = float(threshold)
+        self.warmup = int(warmup)
+        self.ema_alpha = float(ema_alpha)
+        self.recompile_state = recompile_state
+        self.error_ema: Optional[float] = None
+        self.measured_ema: Optional[float] = None
+        self.samples = 0
+        self.advisories: list[DriftAdvisory] = []
+        self._armed = True
+
+    def set_prediction(self, predicted_s: float):
+        """Point the monitor at a fresh prediction (post-recalibration);
+        resets the error EMA so stale error doesn't instantly re-fire."""
+        self.predicted_s = float(predicted_s)
+        self.error_ema = None
+        self.samples = 0
+        self._armed = True
+
+    def observe(self, step: int, measured_s: float
+                ) -> Optional[DriftAdvisory]:
+        """Feed one step's measured device time; returns an advisory when
+        sustained drift crosses the threshold (else None)."""
+        from .. import telemetry
+
+        if (not math.isfinite(measured_s) or measured_s <= 0.0
+                or self.predicted_s <= 0.0):
+            return None
+        err = abs(measured_s - self.predicted_s) / self.predicted_s
+        a = self.ema_alpha
+        self.error_ema = (err if self.error_ema is None
+                          else (1 - a) * self.error_ema + a * err)
+        self.measured_ema = (measured_s if self.measured_ema is None
+                             else (1 - a) * self.measured_ema
+                             + a * measured_s)
+        self.samples += 1
+        telemetry.counter("costmodel.drift", {
+            "error_ema": self.error_ema,
+            "predicted_ms": self.predicted_s * 1e3,
+            "measured_ms": measured_s * 1e3,
+        })
+        if self.samples <= self.warmup:
+            return None
+        if not self._armed:
+            if self.error_ema < self.threshold / 2:
+                self._armed = True
+            return None
+        if self.error_ema <= self.threshold:
+            return None
+        self._armed = False
+        adv = DriftAdvisory(
+            step=step, predicted_s=self.predicted_s,
+            measured_ema_s=self.measured_ema, error_ema=self.error_ema,
+            threshold=self.threshold,
+            message=(f"cost-model drift: EMA prediction error "
+                     f"{self.error_ema:.2f} > {self.threshold:.2f} "
+                     f"(predicted {self.predicted_s * 1e3:.3f} ms, "
+                     f"measured EMA {self.measured_ema * 1e3:.3f} ms)"))
+        self.advisories.append(adv)
+        telemetry.instant("costmodel.drift.advisory", step=step,
+                          error_ema=self.error_ema)
+        if self.recompile_state is not None and self.recompile_state.trigger():
+            self.recompile_state.alter()
+        return adv
+
+
+def make_recalibration_state(model, top_k: int = 4):
+    """A RecompileState whose alter() re-measures the plan's dominant ops
+    on the local device (CostModel.calibrate_graph) and refreshes the
+    model's predicted step makespan — the canonical drift response. Attach
+    it via DiagnosticsManager(..., recalibrate=True) or pass it to a
+    DriftMonitor directly."""
+    from ..recompile import RecompileState
+
+    def _alter(ff):
+        sr = getattr(ff, "_search_result", None)
+        if sr is None:
+            return
+        us, choice = sr
+        us.cm.calibrate_graph(ff.graph, top_k=top_k)
+        us.cm._cache.clear()
+        t, _ = us.evaluate(choice)
+        ff._predicted_step_s = t
+        diag = getattr(ff, "_diagnostics", None)
+        if diag is not None and diag.drift is not None:
+            diag.drift.set_prediction(t)
+
+    return RecompileState(trigger_func=lambda ff: True,
+                          alter_func=_alter, ffmodel=model)

@@ -1,0 +1,308 @@
+"""Strategy explain: why compile chose the plan it chose.
+
+Daydream (ATC '20, PAPERS.md) argues that optimization decisions become
+auditable only when predictions are attributed at the dependency-graph
+level. The Unity search already prices every op (CostModel.op_cost) and
+evaluates whole plans under the makespan rule (graph_makespan); this module
+re-runs ONE evaluation of the winning choice with per-node collection
+turned on (UnitySearch.evaluate(collect=...)) and writes:
+
+  <telemetry-dir>/strategy_report.json   machine-readable attribution
+  <telemetry-dir>/strategy_report.md     the human-readable rendering
+
+The JSON is self-contained: it carries per-op compute/comm seconds, the
+ICI-axis tags, and the dependency edges *in report index space*, so
+`verify_report_total` (and any external tool) can recompute the plan's
+total predicted cost from the report alone — the acceptance property that
+per-op costs sum, under the makespan rule, to the reported total.
+
+Runner-up plans: the search keeps only the winner, so runner-ups are
+re-derived the way `_refine` explores — the all-data-parallel baseline
+plus single-node config flips of the chosen plan — each priced by the same
+evaluator, ranked by penalized cost, and reported with the margin by which
+they lost.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+_MAX_FLIP_EVALS = 48  # runner-up probing budget (compile-time cost bound)
+
+
+def _detail_edges(us, detail):
+    """Dependency edges in report index space — the same (idx, in_edges)
+    walk _MakespanAccum.makespan performs, so graph_makespan over the
+    collected arrays + these edges reproduces evaluate()'s task graph."""
+    idx = {d["guid"]: i for i, d in enumerate(detail)}
+    src, dst = [], []
+    for d in detail:
+        for e in us.graph.in_edges[d["guid"]]:
+            j = idx.get(e.src)
+            if j is not None:
+                src.append(j)
+                dst.append(idx[d["guid"]])
+    return src, dst
+
+
+def verify_report_total(report: dict) -> float:
+    """Recompute the plan's total predicted cost from the report's own
+    per-op entries and edges under the makespan rule — including, when the
+    plan was costed with --search-overlap-backward-update
+    (report["overlap_sync"]), the per-axis bound where overlapped gradient
+    sync shares its ICI axis's links with path comm. Matches
+    report["total_predicted_s"] by construction — the acceptance check."""
+    from ..search.cost_model import graph_makespan
+
+    ops = report["ops"]
+    if not ops:
+        return 0.0
+    compute = [o["compute_s"] for o in ops]
+    comm = [o["comm_s"] for o in ops]
+    axis = [o["comm_axis_id"] for o in ops]
+    src = [e[0] for e in report["edges"]]
+    dst = [e[1] for e in report["edges"]]
+    total = graph_makespan(compute, comm, src, dst, axis=axis)
+    if report.get("overlap_sync"):
+        # the _MakespanAccum.makespan overlapped-sync bound: sync time on
+        # an axis serializes with that axis's path comm
+        sync_by_axis: dict[int, float] = {}
+        comm_by_axis: dict[int, float] = {}
+        for o in ops:
+            if o["sync_s"] > 0.0:
+                sync_by_axis[o["comm_axis_id"]] = (
+                    sync_by_axis.get(o["comm_axis_id"], 0.0) + o["sync_s"])
+            if o["comm_axis_id"] >= 0:
+                comm_by_axis[o["comm_axis_id"]] = (
+                    comm_by_axis.get(o["comm_axis_id"], 0.0) + o["comm_s"])
+        for ax, s in sync_by_axis.items():
+            total = max(total, s + comm_by_axis.get(ax, 0.0))
+    return total
+
+
+def _segment_of(us):
+    """{guid -> segment index}: ops grouped by the bottleneck cuts the
+    sequence DP splits at (UnitySearch.bottlenecks)."""
+    try:
+        cuts = {n.guid for n in us.bottlenecks()}
+    except Exception:
+        cuts = set()
+    seg, out = 0, {}
+    for n in us.order:
+        out[n.guid] = seg
+        if n.guid in cuts:
+            seg += 1
+    return out
+
+
+def _runner_ups(us, choice, chosen_cost: float, top_n: int = 3):
+    """Re-derive the plans the winner beat: the all-dp baseline plus
+    single-node flips of the chosen plan, each priced by the same
+    evaluator. Returns (candidates ranked by cost, evals spent)."""
+    cands = []
+    baseline = {}
+    for n in us.order:
+        try:
+            cfgs = us.node_configs(n)
+        except ValueError:
+            continue
+        if cfgs:
+            baseline[n.guid] = cfgs[0]
+    # NodeConfigs are rebuilt per node_configs() call, so compare by value
+    if baseline and any(baseline.get(g) != c for g, c in choice.items()):
+        t, mem = us.evaluate(baseline)
+        cands.append({
+            "label": "all-" + next(iter(baseline.values())).name
+            if len({c.name for c in baseline.values()}) == 1
+            else "baseline (first configs)",
+            "cost_s": us._memory_penalized(t, mem),
+            "makespan_s": t, "memory_bytes": mem, "changes": []})
+    evals = 0
+    for n in us.order:
+        if evals >= _MAX_FLIP_EVALS:
+            break
+        cur = choice.get(n.guid)
+        if cur is None:
+            continue
+        try:
+            alts = us.node_configs(n)
+        except ValueError:
+            continue
+        for cfg in alts:
+            if cfg is cur or cfg.name == cur.name:
+                continue
+            if evals >= _MAX_FLIP_EVALS:
+                break
+            cand = dict(choice)
+            cand[n.guid] = cfg
+            t, mem = us.evaluate(cand)
+            evals += 1
+            cands.append({
+                "label": f"{n.name}: {cur.name} → {cfg.name}",
+                "cost_s": us._memory_penalized(t, mem),
+                "makespan_s": t, "memory_bytes": mem,
+                "changes": [{"op": n.name, "from": cur.name,
+                             "to": cfg.name}]})
+    cands.sort(key=lambda c: c["cost_s"])
+    for c in cands:
+        c["margin_s"] = c["cost_s"] - chosen_cost
+    return cands[:top_n], evals
+
+
+def build_strategy_report(model) -> dict:
+    """Attribution of the compiled plan's predicted cost. Uses the search
+    state compile stashed (`model._search_result`); when the plan was not
+    searched locally (pure data parallel, imported/broadcast strategy) the
+    default-config assignment is evaluated instead and the report says so
+    (`mode: "dp_fallback"`)."""
+    from ..search.cost_model import CostModel
+    from ..search.machine_model import machine_model_for_mesh
+
+    sr = getattr(model, "_search_result", None)
+    if sr is not None:
+        us, choice = sr
+        mode = "searched"
+    else:
+        from ..search.unity import UnitySearch
+
+        machine = machine_model_for_mesh(
+            model.mesh, num_hosts=model.config.num_nodes)
+        opt_slots = (model.optimizer.num_slots
+                     if model.optimizer is not None else 1)
+        cm = CostModel(machine, opt_slots=opt_slots)
+        us = UnitySearch(model.graph, model.mesh, model.config, cm,
+                         refine=False)
+        choice = {}
+        for n in us.order:
+            try:
+                cfgs = us.node_configs(n)
+            except ValueError:
+                cfgs = []
+            if cfgs:
+                choice[n.guid] = cfgs[0]
+        mode = "dp_fallback"
+
+    detail: list[dict] = []
+    makespan, mem = us.evaluate(choice, collect=detail)
+    src, dst = _detail_edges(us, detail)
+    seg_of = _segment_of(us)
+    chosen_cost = us._memory_penalized(makespan, mem)
+    runner_ups, flip_evals = _runner_ups(us, choice, chosen_cost)
+
+    # axis id -> mesh axis name, from the accumulator's own id assignment
+    # (the id is the node's first comm axis, in encounter order)
+    axis_names: dict[int, str] = {}
+    for d in detail:
+        if d["comm_axis_id"] >= 0 and d["comm_axes"]:
+            axis_names.setdefault(d["comm_axis_id"], d["comm_axes"][0])
+
+    ops = []
+    for d in detail:
+        ops.append({
+            "name": d["name"], "op_type": d["op_type"],
+            "config": d["config"],
+            "segment": seg_of.get(d["guid"], 0),
+            "compute_s": d["compute_s"],
+            "forward_s": d["forward_s"], "backward_s": d["backward_s"],
+            "comm_s": d["comm_s"],
+            "reshard_s": d["reshard_s"], "collective_s": d["collective_s"],
+            "sync_s": d["sync_s"],
+            "comm_axis_id": d["comm_axis_id"],
+            "memory_bytes": d["memory_bytes"],
+        })
+    report = {
+        "kind": "strategy_report",
+        "mode": mode,
+        "mesh_axes": {k: int(v) for k, v in
+                      getattr(model.mesh, "shape", {}).items()},
+        "overlap_sync": bool(us.config.search_overlap_backward_update),
+        "total_predicted_s": makespan,
+        "penalized_cost_s": chosen_cost,
+        "peak_memory_bytes": mem,
+        "sum_compute_s": float(sum(o["compute_s"] for o in ops)),
+        "sum_comm_s": float(sum(o["comm_s"] for o in ops)),
+        "comm_axis_names": axis_names,
+        "ops": ops,
+        "edges": [[s, d] for s, d in zip(src, dst)],
+        "runner_ups": runner_ups,
+        "runner_up_evals": flip_evals,
+    }
+    return report
+
+
+def render_markdown(report: dict) -> str:
+    """Human-readable twin of the JSON report."""
+    lines = ["# Strategy explain report", ""]
+    mesh = ", ".join(f"{k}={v}" for k, v in report["mesh_axes"].items())
+    lines += [
+        f"- mesh: `{mesh}`  ·  mode: {report['mode']}",
+        f"- **predicted step makespan: "
+        f"{report['total_predicted_s'] * 1e3:.3f} ms** "
+        f"(Σcompute {report['sum_compute_s'] * 1e3:.3f} ms, "
+        f"Σcomm {report['sum_comm_s'] * 1e3:.3f} ms)",
+        f"- peak per-chip memory: "
+        f"{report['peak_memory_bytes'] / 2**20:.1f} MiB",
+        "",
+        "## Per-op attribution",
+        "",
+        "| op | type | config | seg | fwd+bwd (ms) | reshard (ms) "
+        "| collective (ms) | sync (ms) | mem (MiB) |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    ranked = sorted(report["ops"],
+                    key=lambda o: -(o["compute_s"] + o["comm_s"]))
+    for o in ranked:
+        lines.append(
+            f"| {o['name']} | {o['op_type']} | {o['config']} "
+            f"| {o['segment']} "
+            f"| {o['compute_s'] * 1e3:.3f} "
+            f"| {o['reshard_s'] * 1e3:.3f} "
+            f"| {o['collective_s'] * 1e3:.3f} "
+            f"| {o['sync_s'] * 1e3:.3f} "
+            f"| {o['memory_bytes'] / 2**20:.1f} |")
+    segs: dict[int, dict] = {}
+    for o in report["ops"]:
+        s = segs.setdefault(o["segment"], {"compute": 0.0, "comm": 0.0,
+                                           "n": 0})
+        s["compute"] += o["compute_s"]
+        s["comm"] += o["comm_s"]
+        s["n"] += 1
+    lines += ["", "## Per-segment totals (bottleneck cuts)", "",
+              "| segment | ops | compute (ms) | comm (ms) |",
+              "|---|---|---|---|"]
+    for k in sorted(segs):
+        s = segs[k]
+        lines.append(f"| {k} | {s['n']} | {s['compute'] * 1e3:.3f} "
+                     f"| {s['comm'] * 1e3:.3f} |")
+    lines += ["", "## Runner-up plans", ""]
+    if report["runner_ups"]:
+        lines += ["| plan | cost (ms) | lost by (ms) |", "|---|---|---|"]
+        for r in report["runner_ups"]:
+            lines.append(f"| {r['label']} | {r['cost_s'] * 1e3:.3f} "
+                         f"| +{r['margin_s'] * 1e3:.3f} |")
+        lines += ["",
+                  f"({report['runner_up_evals']} single-flip candidates "
+                  f"re-priced by the search evaluator)"]
+    else:
+        lines.append("(no alternative configurations on this mesh)")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def write_strategy_report(model, directory: str) -> Optional[dict]:
+    """Build + persist strategy_report.{json,md} under `directory`.
+    Returns the report dict, or None when the model has no graph yet."""
+    if getattr(model, "graph", None) is None or model.mesh is None:
+        return None
+    report = build_strategy_report(model)
+    os.makedirs(directory, exist_ok=True)
+    jpath = os.path.join(directory, "strategy_report.json")
+    tmp = jpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+    os.replace(tmp, jpath)
+    with open(os.path.join(directory, "strategy_report.md"), "w") as f:
+        f.write(render_markdown(report))
+    return report

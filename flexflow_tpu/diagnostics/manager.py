@@ -1,0 +1,131 @@
+"""DiagnosticsManager: one run's explain + drift + health, wired to fit.
+
+Owned by FFModel (`--diagnostics` / `model.enable_diagnostics()` / the
+keras Diagnostics callback). Lifecycle:
+
+  compile end  → write strategy_report.{json,md}; stash the plan's
+                 predicted makespan for the drift monitor
+  each step    → health rules over the step record (loss included — the
+                 scalar fetch happens only with diagnostics on), drift
+                 monitor over measured device time
+  fit end      → drain alerts; summary counts into the metrics log
+
+All artifacts live in the telemetry session's directory:
+
+  strategy_report.json / strategy_report.md
+  alerts.jsonl          one JSON record per alert/advisory
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..telemetry import log as fflog
+from ..telemetry.recorder import MetricsRecorder
+from .drift import DriftMonitor, make_recalibration_state
+from .explain import write_strategy_report
+from .health import HealthMonitor, default_rules
+
+
+class DiagnosticsManager:
+    def __init__(self, model, session, drift_threshold: float = 0.5,
+                 abort_on: tuple = (), recalibrate: bool = False,
+                 rules=None):
+        self.model = model
+        self.session = session
+        self.directory = session.directory
+        self.alerts_path = os.path.join(self.directory, "alerts.jsonl")
+        self._alerts = MetricsRecorder(self.alerts_path)
+        self.health = HealthMonitor(
+            rules if rules is not None
+            else default_rules(getattr(model, "config", None)),
+            abort_on=tuple(abort_on), sink=self._sink_alert)
+        self.drift_threshold = float(drift_threshold)
+        self._recalibrate = bool(recalibrate)
+        self.drift: Optional[DriftMonitor] = None
+        self.report: Optional[dict] = None
+
+    # ------------------------------------------------------------ compile
+
+    def on_compile(self):
+        """Write the strategy explain report and arm the drift monitor
+        with the chosen plan's predicted makespan."""
+        from .. import telemetry
+
+        with telemetry.span("diagnostics.explain"):
+            self.report = write_strategy_report(self.model, self.directory)
+        if self.report is None:
+            return
+        predicted = self.report["total_predicted_s"]
+        self.model._predicted_step_s = predicted
+        rs = (make_recalibration_state(self.model)
+              if self._recalibrate else None)
+        self.drift = DriftMonitor(predicted,
+                                  threshold=self.drift_threshold,
+                                  recompile_state=rs)
+        telemetry.event(
+            "strategy_report", path=os.path.join(
+                self.directory, "strategy_report.json"),
+            total_predicted_s=predicted,
+            mode=self.report["mode"],
+            runner_ups=len(self.report["runner_ups"]))
+        fflog.info(
+            "diagnostics: strategy report written to %s "
+            "(predicted step makespan %.3f ms, mode=%s)",
+            os.path.join(self.directory, "strategy_report.md"),
+            predicted * 1e3, self.report["mode"])
+
+    # ------------------------------------------------------------ steps
+
+    def on_step(self, rec: dict):
+        """One per-step record (the metrics.jsonl step schema + loss).
+        Raises health.HealthAbort when an abort-listed rule fires."""
+        # health first: a NaN-loss abort should not be preceded by a
+        # drift advisory computed from the same broken step
+        self.health.observe_step(rec)
+        if self.drift is not None:
+            dev = rec.get("device_time_s")
+            if dev is not None:
+                adv = self.drift.observe(int(rec.get("step", 0)),
+                                         float(dev))
+                if adv is not None:
+                    self._alerts.record("advisory", **adv.to_record())
+                    fflog.warning("diagnostics: %s", adv.message)
+
+    def note_checkpoint_commit(self, t: Optional[float]):
+        rule = self.health.rule("ckpt_stale")
+        if rule is not None:
+            rule.note_commit(t)
+
+    # ------------------------------------------------------------ alerts
+
+    def _sink_alert(self, alert):
+        from .. import telemetry
+
+        self._alerts.record("alert", **alert.to_record())
+        telemetry.instant(f"alert.{alert.rule}", step=alert.step,
+                          level=alert.level)
+        emit = fflog.error if alert.level == "error" else fflog.warning
+        emit("diagnostics[%s]: %s", alert.rule, alert.message)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def on_fit_end(self):
+        """Summarize into the metrics log; alerts.jsonl stays open for a
+        later fit() on the same model (close() finalizes)."""
+        from .. import telemetry
+
+        n_alerts = len(self.health.alerts)
+        n_adv = len(self.drift.advisories) if self.drift else 0
+        telemetry.event("diagnostics_summary", alerts=n_alerts,
+                        drift_advisories=n_adv,
+                        drift_error_ema=(self.drift.error_ema
+                                         if self.drift else None))
+        if n_alerts or n_adv:
+            fflog.warning(
+                "diagnostics: %d health alert(s), %d drift advisory/ies — "
+                "see %s", n_alerts, n_adv, self.alerts_path)
+
+    def close(self):
+        self._alerts.close()

@@ -188,6 +188,43 @@ class Telemetry(Callback):
             self.session.flush()
 
 
+class Diagnostics(Callback):
+    """Enable the diagnostics subsystem (diagnostics/) for keras-style
+    training: strategy explain report, cost-model drift monitoring, and
+    run-health anomaly alerts, with artifacts (strategy_report.json/md,
+    alerts.jsonl) under `directory` next to the telemetry files. The
+    callback twin of --diagnostics; implies telemetry in the same
+    directory when no session exists yet.
+
+    `abort_on` lists rule names ("nan_loss", "step_spike",
+    "data_wait_stall", "ckpt_stale") that stop training (HealthAbort)
+    instead of warning. Both settings default to None — leave unset to
+    inherit whatever --drift-threshold / --health-abort-on configured
+    (passing values here overrides the flags).
+    """
+
+    def __init__(self, directory: str, drift_threshold=None,
+                 abort_on=None):
+        super().__init__()
+        self.directory = directory
+        self.drift_threshold = drift_threshold
+        self.abort_on = abort_on if abort_on is None else tuple(abort_on)
+        self.manager = None
+
+    def on_train_begin(self, logs=None):
+        ff = self.model.ffmodel
+        assert ff is not None, "compile() before fit with Diagnostics"
+        self.manager = ff.enable_diagnostics(
+            self.directory, drift_threshold=self.drift_threshold,
+            abort_on=self.abort_on)
+
+    def on_train_end(self, logs=None):
+        if self.manager is not None:
+            session = self.model.ffmodel.get_telemetry()
+            if session is not None:
+                session.flush()
+
+
 class VerifyMetrics(Callback):
     """Assert the final train accuracy clears a gate (AE scripts' check)."""
 

@@ -115,6 +115,12 @@ class FFModel:
         self._auto_resumed = False  # auto-resume fires at most once
         self._resume_cursor = None  # (absolute epoch, batch) to resume at
         self._telemetry = None  # TelemetrySession (telemetry/session.py)
+        self._diagnostics = None  # DiagnosticsManager (diagnostics/)
+        # (UnitySearch, choice) of the winning plan — kept after compile so
+        # diagnostics/explain can attribute the predicted makespan per op
+        # and re-rank runner-up plans without re-running the search
+        self._search_result = None
+        self._predicted_step_s = None  # chosen plan's predicted makespan
 
     # ================================================== tensor creation
 
@@ -644,6 +650,11 @@ class FFModel:
                     strategy_nodes=sorted(self._strategy)
                     if self._strategy else [],
                 )
+                diag = self._maybe_enable_diagnostics()
+                if diag is not None:
+                    # strategy explain + drift-monitor arming, inside the
+                    # active-session window so its spans/events land here
+                    diag.on_compile()
         finally:
             if tel is not None:
                 # flush in the finally: a compile/search crash is exactly
@@ -793,6 +804,7 @@ class FFModel:
                 with telemetry.span("compile.search", mode="multihost"):
                     self._strategy = run_search_on_host0(_search)
                 self._assign_strategy()
+                self._search_result = None  # plan arrived as a broadcast
             elif self.config.search_mesh_shapes:
                 # also search the mesh factorization itself (the MachineView
                 # grid-shape half of Unity, search/mesh_search.py): divisor
@@ -844,6 +856,7 @@ class FFModel:
                     tuple(sizes[a] for a in ms.axis_names), ms.axis_names))
                 self.graph = g
                 self._strategy = us.to_strategy(choice).overrides
+                self._search_result = (us, choice)
                 used_substitutions = True
             else:
                 _calibrate()
@@ -852,6 +865,7 @@ class FFModel:
                         g, self.mesh, self.config, cost_model)
                 self.graph = g
                 self._strategy = us.to_strategy(choice).overrides
+                self._search_result = (us, choice)
                 used_substitutions = True
         else:
             self._assign_strategy()
@@ -1033,6 +1047,90 @@ class FFModel:
         """The model's TelemetrySession, or None when telemetry is off."""
         return self._telemetry
 
+    def enable_diagnostics(self, directory: str = "",
+                           drift_threshold: Optional[float] = None,
+                           abort_on: Optional[Sequence[str]] = None,
+                           recalibrate: bool = False, rules=None):
+        """Attach the diagnostics subsystem (diagnostics/): strategy
+        explain report at compile, online cost-model drift monitoring and
+        run-health anomaly rules during fit, artifacts next to the
+        telemetry session's (strategy_report.json/md, alerts.jsonl). The
+        programmatic twin of --diagnostics; `directory` enables telemetry
+        there first when no session exists yet."""
+        from .diagnostics import DiagnosticsManager
+
+        if directory:
+            self.enable_telemetry(directory)
+        elif self._telemetry is None and self.config.telemetry_dir:
+            self.enable_telemetry(self.config.telemetry_dir)
+        if self._telemetry is None:
+            raise ValueError(
+                "diagnostics requires telemetry: pass a directory, set "
+                "--telemetry-dir, or call enable_telemetry() first")
+        if self._diagnostics is None:
+            self._diagnostics = DiagnosticsManager(
+                self, self._telemetry,
+                drift_threshold=(self.config.drift_threshold
+                                 if drift_threshold is None
+                                 else drift_threshold),
+                abort_on=tuple(self.config.health_abort_on
+                               if abort_on is None else abort_on),
+                recalibrate=recalibrate, rules=rules)
+        elif (drift_threshold is not None or abort_on is not None
+                or recalibrate or rules is not None):
+            # e.g. --diagnostics attached a manager at compile and a keras
+            # Diagnostics(abort_on=...) callback asks for different
+            # settings later: apply what can be applied live (abort set,
+            # drift threshold) rather than silently dropping an explicit
+            # abort request; rule objects are already running, so a new
+            # rule set can't be swapped in — say so
+            from .telemetry import log as fflog
+
+            diag = self._diagnostics
+            if abort_on is not None:
+                diag.health.set_abort_on(tuple(abort_on))
+            if drift_threshold is not None:
+                diag.drift_threshold = float(drift_threshold)
+                if diag.drift is not None:
+                    diag.drift.threshold = float(drift_threshold)
+            if recalibrate:
+                from .diagnostics.drift import make_recalibration_state
+
+                diag._recalibrate = True
+                if diag.drift is not None \
+                        and diag.drift.recompile_state is None:
+                    diag.drift.recompile_state = \
+                        make_recalibration_state(self)
+            if rules is not None:
+                fflog.warning(
+                    "enable_diagnostics: custom rules ignored — this "
+                    "model's diagnostics manager already runs its rule "
+                    "set (pass rules on the FIRST enable_diagnostics "
+                    "call)")
+        return self._diagnostics
+
+    def get_diagnostics(self):
+        """The model's DiagnosticsManager, or None when diagnostics is
+        off."""
+        return self._diagnostics
+
+    def _maybe_enable_diagnostics(self):
+        """Config-driven lazy attach (mirrors the telemetry lazy attach);
+        --diagnostics without --telemetry-dir warns once instead of
+        silently doing nothing."""
+        from .telemetry import log as fflog
+
+        if self._diagnostics is not None or not self.config.diagnostics:
+            return self._diagnostics
+        if self._telemetry is None and not self.config.telemetry_dir:
+            if not getattr(self, "_diag_warned", False):
+                self._diag_warned = True
+                fflog.warning(
+                    "--diagnostics ignored: no --telemetry-dir (the "
+                    "report/alert artifacts need a telemetry directory)")
+            return None
+        return self.enable_diagnostics()
+
     def _py_step(self) -> int:
         """The device step counter as a host int — THE checkpoint step
         numbering convention (fit's policy decisions, explicit saves, and
@@ -1090,6 +1188,12 @@ class FFModel:
             # idempotent: covers sessions attached after compile (keras
             # Telemetry callback, manual enable_telemetry)
             tel.write_manifest(self)
+        diag = self._maybe_enable_diagnostics()
+        if diag is not None and diag.report is None:
+            # diagnostics attached after compile (keras Diagnostics
+            # callback, manual enable): write the explain report and arm
+            # the drift monitor now
+            diag.on_compile()
         epoch_log = fflog.info if verbose else fflog.debug
         if self.config.profiling and not getattr(self, "_profiled", False):
             # --profiling: per-op kernel table, printed once per compile
@@ -1159,9 +1263,13 @@ class FFModel:
 
         import contextlib
 
+        from .diagnostics.health import HealthAbort
         from .resilience.fault import SimulatedPreemption
         from .resilience.policy import PreemptionHandler
 
+        if diag is not None and resil is not None:
+            # staleness clock starts at fit start; every commit re-feeds it
+            diag.note_checkpoint_commit(time.time())
         preempt = PreemptionHandler() if resil is not None else None
         preempted = False
         with contextlib.ExitStack() as stack:
@@ -1237,11 +1345,45 @@ class FFModel:
                                 else:
                                     resil.maybe_save(py_step, cursor)
                         if tel is not None:
+                            save_lat = time.perf_counter() - t_save0
+                            loss_val = None
+                            if diag is not None:
+                                # the scalar loss fetch is a device sync
+                                # and happens ONLY with diagnostics on —
+                                # BEFORE step_time is read, so the drained
+                                # device work lands inside this step's own
+                                # timed window (fetching after it would
+                                # leave every window measuring dispatch
+                                # only, and the drift monitor would
+                                # compare the predicted makespan against
+                                # host overhead)
+                                loss_val = float(np.asarray(
+                                    jax.device_get(lval)))
+                            step_time = time.perf_counter() - t_it0
                             tel.record_step(
-                                py_step, abs_e,
-                                time.perf_counter() - t_it0, data_wait,
-                                time.perf_counter() - t_save0,
-                                batch_size, tokens_per_example)
+                                py_step, abs_e, step_time, data_wait,
+                                save_lat, batch_size, tokens_per_example)
+                            if diag is not None:
+                                if resil is not None:
+                                    # checkpointer stamps commits on the
+                                    # monotonic clock; the staleness rule
+                                    # runs on wall time — convert
+                                    lc = resil.checkpointer._last_commit_t
+                                    if lc is not None:
+                                        diag.note_checkpoint_commit(
+                                            time.time()
+                                            - (time.monotonic() - lc))
+                                diag.on_step({
+                                    "step": py_step, "epoch": abs_e,
+                                    "t": time.time(),
+                                    "step_time_s": step_time,
+                                    "data_wait_s": data_wait,
+                                    "save_latency_s": save_lat,
+                                    "device_time_s": max(
+                                        0.0, step_time - data_wait
+                                        - save_lat),
+                                    "loss": loss_val,
+                                })
                         if self._fault_hook is not None:
                             self._fault_hook(py_step)
                         if preempted:
@@ -1268,6 +1410,17 @@ class FFModel:
                 if resil is not None:
                     resil.checkpointer.abort()
                 raise
+            except HealthAbort:
+                # a health rule listed in --health-abort-on fired: stop
+                # training with artifacts intact. Drain the in-flight
+                # async save but do NOT final-snapshot — a NaN'd model is
+                # not worth committing over the last good checkpoint
+                if resil is not None:
+                    resil.finalize()
+                fflog.error(
+                    "fit aborted by diagnostics at step %d (see %s)",
+                    py_step, diag.alerts_path if diag else "alerts.jsonl")
+                raise
             else:
                 # the next fit() call continues the absolute epoch count
                 # (fresh shuffle orders for keras's repeated fit(epochs=1))
@@ -1281,6 +1434,8 @@ class FFModel:
                     # The in-flight checkpoint writer was already drained
                     # on every exit path, so no late events are lost by
                     # deactivating here.
+                    if diag is not None:
+                        diag.on_fit_end()
                     tel.write_summary()
                     tel.flush()
                     telemetry.deactivate(tel)

@@ -269,7 +269,8 @@ class UnitySearch:
 
     # ---------------------------------------------------- strategy evaluation
 
-    def evaluate(self, choice: dict, only=None) -> tuple[float, float]:
+    def evaluate(self, choice: dict, only=None,
+                 collect=None) -> tuple[float, float]:
         """(makespan seconds, peak per-chip memory bytes) of a full
         assignment {guid -> NodeConfig} — the simulate_runtime analog:
         per-node compute serializes across the chip set while communication
@@ -278,7 +279,16 @@ class UnitySearch:
         (native ff_eval_makespan), not an additive sum — concurrent
         branches (DLRM towers) are priced at max(paths). `only` restricts
         accumulation to a guid subset (segment costing): configs outside it
-        still feed reshard classification but don't contribute cost."""
+        still feed reshard classification but don't contribute cost.
+
+        `collect`, when an EMPTY list, receives one dict per accumulated
+        node with the full cost attribution (forward/backward/sync/reshard/
+        collective seconds, per-chip memory bytes, comm axes) in
+        accumulation order — the substrate of the strategy explain report
+        (diagnostics/explain). Each entry also carries the accumulator's
+        actual per-task (compute_s, comm_s, comm_axis_id) so the report
+        reproduces the evaluator's makespan by construction, not by
+        re-deriving the accumulation rules."""
         self.evals += 1
         acc = _MakespanAccum(
             overlap_sync=self.config.search_overlap_backward_update)
@@ -312,6 +322,13 @@ class UnitySearch:
                             cfg.in_assigns[e.dst_idx], pt.dtype,
                             self.cm.machine)
                 acc.add(node.guid, 0.0, comm, comm_axes=comm_axes)
+                if collect is not None:
+                    collect.append({
+                        "guid": node.guid, "name": node.name,
+                        "op_type": node.op_type.name, "config": cfg.name,
+                        "forward_s": 0.0, "backward_s": 0.0, "sync_s": 0.0,
+                        "reshard_s": 0.0, "collective_s": comm,
+                        "memory_bytes": 0.0, "comm_axes": list(comm_axes)})
                 continue
             in_shapes, in_assigns, reshard = [], [], 0.0
             for e in sorted(self.graph.in_edges[node.guid],
@@ -399,6 +416,29 @@ class UnitySearch:
                     cm.comm_time + reshard + psum,
                     comm_axes=comm_axes, sync=cm.sync_time)
             mem += cm.memory
+            if collect is not None:
+                # compute_t may carry the pipeline bubble stretch; report
+                # the stretched split so entries still sum to compute_t
+                stretch = (compute_t
+                           / max(cm.forward_time + cm.backward_time, 1e-30))
+                collect.append({
+                    "guid": node.guid, "name": node.name,
+                    "op_type": node.op_type.name, "config": cfg.name,
+                    "forward_s": cm.forward_time * stretch,
+                    "backward_s": cm.backward_time * stretch,
+                    "sync_s": cm.sync_time,
+                    "reshard_s": reshard,
+                    "collective_s": cm.comm_time + psum,
+                    "memory_bytes": cm.memory,
+                    "comm_axes": list(comm_axes)})
+        if collect is not None:
+            # entries align 1:1 with the accumulator's task arrays (both
+            # append once per accumulated node, in self.order)
+            for d, c, q, ax in zip(collect, acc.compute, acc.comm,
+                                   acc.axis):
+                d["compute_s"] = c
+                d["comm_s"] = q
+                d["comm_axis_id"] = ax
         return acc.makespan(self.graph.in_edges), mem
 
     def _expected_input(self, node, cfg, dst_idx, ndim):

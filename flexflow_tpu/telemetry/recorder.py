@@ -50,6 +50,11 @@ class MetricsRecorder:
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         self._lock = threading.Lock()
         self._f = open(self.path, "a")
+        # late-write accounting: records arriving after close() (e.g. from
+        # the async checkpoint writer outliving the session) are dropped on
+        # purpose, but COUNTED — a nonzero count means the log is missing
+        # events it was asked to carry, which run_doctor can surface
+        self.dropped_after_close = 0
 
     def record(self, kind: str, **fields: Any):
         rec = {"kind": kind, "t": time.time()}
@@ -57,6 +62,7 @@ class MetricsRecorder:
         line = json.dumps(rec, default=_json_default)
         with self._lock:
             if self._f.closed:  # late writer-thread event after close
+                self.dropped_after_close += 1
                 return
             self._f.write(line + "\n")
             self._f.flush()
@@ -75,12 +81,26 @@ def _json_default(o):
         return repr(o)
 
 
-def read_jsonl(path: str) -> list[dict]:
-    """Parse a metrics log back into records (validation / tests / CI)."""
+def read_jsonl(path: str, strict: bool = False) -> list[dict]:
+    """Parse a metrics log back into records (validation / tests / CI).
+
+    A mid-write SIGKILL (real preemptions, fault-injection tests) leaves a
+    truncated final line; that partial record is dropped rather than making
+    the whole log unreadable — exactly the log a post-mortem most needs to
+    read. A malformed record anywhere ELSE still raises (the file is
+    corrupt, not merely torn); strict=True raises on any undecodable line,
+    including the last."""
     out = []
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                out.append(json.loads(line))
+        lines = f.readlines()
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            if strict or i < len(lines) - 1:
+                raise
+            # torn final record from a mid-write kill: ignore
     return out

@@ -29,6 +29,7 @@ _MANIFEST_CONFIG_FIELDS = (
     "search_mesh_shapes", "only_data_parallel", "enable_substitutions",
     "profiling", "computation_dtype", "checkpoint_dir", "checkpoint_every",
     "checkpoint_every_seconds", "auto_resume", "seed",
+    "diagnostics", "drift_threshold",
 )
 
 
@@ -48,6 +49,7 @@ class TelemetrySession:
         self._tokens = 0
         self._train_seconds = 0.0
         self._last_summary_steps = -1
+        self._dropped_warned = False
         self._closed = False
 
     # ------------------------------------------------------------ manifest
@@ -134,6 +136,20 @@ class TelemetrySession:
             fields["tokens_per_sec"] = (
                 self._tokens / self._train_seconds
                 if self._train_seconds > 0 else 0.0)
+        dropped = self.tracer.dropped
+        if dropped:
+            # a capped trace is NOT a complete trace: say so in the summary
+            # record AND out loud — buried as a counter inside trace.json
+            # (tracer.to_dict) the drop looks like a complete timeline
+            fields["trace_dropped_events"] = int(dropped)
+            if not self._dropped_warned:
+                self._dropped_warned = True
+                from . import log
+
+                log.warning(
+                    "telemetry: trace buffer cap reached — %d event(s) "
+                    "dropped; %s is truncated (raise Tracer max_events or "
+                    "shorten the run)", dropped, self.trace_path)
         self.recorder.record("summary", **fields)
 
     # ------------------------------------------------------------ lifecycle

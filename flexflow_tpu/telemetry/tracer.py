@@ -83,6 +83,14 @@ class Tracer:
                 return
             self._events.append(ev)
 
+    @property
+    def dropped(self) -> int:
+        """Events lost to the `max_events` cap — surfaced by the session's
+        end-of-fit summary and a telemetry.log warning so a silently
+        truncated trace never masquerades as a complete one."""
+        with self._lock:
+            return self._dropped
+
     def span(self, name: str, **args) -> _Span:
         """`with tracer.span("compile"): ...` — one X event on exit."""
         return _Span(self, name, args or None)

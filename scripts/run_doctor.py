@@ -1,0 +1,81 @@
+"""Run doctor CLI: render a post-mortem report from any telemetry dir.
+
+Usage:
+    python scripts/run_doctor.py TELEMETRY_DIR [--out report.md]
+        [--json] [--check] [--strict]
+
+  --out FILE   write the markdown report to FILE (default: stdout)
+  --json       emit the structured diagnose() dict instead of markdown
+  --check      exit nonzero unless the diagnostics artifacts exist and
+               parse (strategy_report.json + alerts.jsonl + metrics.jsonl)
+               — the CI acceptance gate
+  --strict     additionally exit nonzero when the verdict is "dead"
+               (error-level / abort alerts present)
+
+Reads only files — no devices, no live run — so it works on any telemetry
+dir copied off the machine that produced it.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("directory", help="telemetry dir of the run")
+    ap.add_argument("--out", default="", help="write markdown here")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--strict", action="store_true")
+    args = ap.parse_args()
+
+    from flexflow_tpu.diagnostics.doctor import diagnose, render
+
+    if not os.path.isdir(args.directory):
+        print(f"run_doctor: no such directory {args.directory!r}",
+              file=sys.stderr)
+        sys.exit(2)
+
+    if args.check:
+        problems = []
+        for name in ("metrics.jsonl", "alerts.jsonl",
+                     "strategy_report.json"):
+            p = os.path.join(args.directory, name)
+            if not os.path.exists(p):
+                problems.append(f"missing {name}")
+        if not problems:
+            from flexflow_tpu.diagnostics.explain import verify_report_total
+
+            rep = json.load(open(
+                os.path.join(args.directory, "strategy_report.json")))
+            total = verify_report_total(rep)
+            if abs(total - rep["total_predicted_s"]) > 1e-9 + 1e-6 * abs(
+                    rep["total_predicted_s"]):
+                problems.append(
+                    f"strategy_report per-op costs ({total}) do not "
+                    f"reproduce total_predicted_s "
+                    f"({rep['total_predicted_s']}) under the makespan rule")
+        if problems:
+            print("run_doctor: CHECK FAILED: " + "; ".join(problems),
+                  file=sys.stderr)
+            sys.exit(1)
+
+    d = diagnose(args.directory)
+    out = (json.dumps(d, indent=1, default=str) if args.as_json
+           else render(d))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out)
+        print(f"run_doctor: report written to {args.out}")
+    else:
+        print(out)
+    if args.strict and d["verdict"] == "dead":
+        sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()
