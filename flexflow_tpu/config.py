@@ -128,6 +128,19 @@ class FFConfig:
     diagnostics: bool = False
     drift_threshold: float = 0.5
     health_abort_on: tuple[str, ...] = ()
+    # pipelined execution engine (engine/): fit runs chunks of N train
+    # steps as ONE donated lax.scan dispatch over batches prefetched by a
+    # background thread; checkpoints/preemption land at chunk boundaries.
+    # 1 = the eager per-step loop (default; bit-identical trajectories
+    # either way — docs/performance.md).
+    pipeline_steps: int = 1
+    # eager-loop diagnostics loss fetch cadence: the per-step device_get
+    # is a full device drain; K>1 samples it every K-th step and the
+    # health/drift rules then see one K-step-AVERAGED record per window
+    # (raw per-window timings are bimodal under async dispatch — the
+    # sampled step absorbs the drain the others skipped). Pipelined mode
+    # gets every step's loss from the per-chunk vector regardless.
+    health_sample_every: int = 1
 
     def __post_init__(self):
         argv = sys.argv[1:]
@@ -308,6 +321,10 @@ class FFConfig:
             elif a == "--health-abort-on":
                 self.health_abort_on = tuple(
                     r.strip() for r in val().split(",") if r.strip())
+            elif a == "--pipeline-steps":
+                self.pipeline_steps = int(val())
+            elif a == "--health-sample-every":
+                self.health_sample_every = int(val())
             elif a == "--synthetic-input":
                 self.synthetic_input = True
             elif a == "--allow-tensor-op-math-conversion":

@@ -29,6 +29,10 @@ class SingleDataLoader:
         self.num_samples = int(full_array.shape[0])
         self.batch_size = batch_tensor.dims[0]
         self.next_index = 0
+        # the input's device sharding, resolved once on first use: the
+        # spec cannot change after compile, so the per-batch linear scan
+        # of graph.sources() was pure overhead in the hot path
+        self._sharding = None
 
     @property
     def num_batches(self) -> int:
@@ -59,16 +63,23 @@ class SingleDataLoader:
             self.next_index += self.batch_size
             return self.full_array[sl]
 
+    def _resolve_sharding(self):
+        """The input node's NamedSharding, cached at first use (False
+        when the tensor is not a graph input — plain device_put then)."""
+        if self._sharding is None:
+            ff = self.ffmodel
+            spec = ff._input_partition_spec(self.batch_tensor.name)
+            self._sharding = (NamedSharding(ff.mesh, spec)
+                              if spec is not None else False)
+        return self._sharding
+
     def next_batch_sharded(self):
         """Batch pre-placed on the mesh with the input's sharding. The
         data_wait span covers slice + device_put — the host-side stall a
         training step pays before dispatch (telemetry/)."""
         with telemetry.span("data_wait"):
             batch = self.next_batch()
-            ff = self.ffmodel
-            for node in ff.graph.sources():
-                if node.name == self.batch_tensor.name:
-                    spec = node.outputs[0].partition_spec()
-                    return jax.device_put(
-                        batch, NamedSharding(ff.mesh, spec))
+            sharding = self._resolve_sharding()
+            if sharding is not False:
+                return jax.device_put(batch, sharding)
             return jax.device_put(batch)

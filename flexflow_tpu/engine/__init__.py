@@ -1,0 +1,18 @@
+"""Pipelined execution engine (docs/performance.md).
+
+`FFModel.fit` routes through PipelinedEngine when `--pipeline-steps N`
+(or `fit(..., pipeline_steps=N)`) is > 1: chunks of N train steps run as
+one donated `lax.scan` dispatch over batches a background thread staged
+onto the mesh ahead of time, with per-step telemetry/diagnostics
+reconstructed at chunk boundaries. Default stays the eager per-step
+loop (`pipeline_steps=1`), which is bit-identical by construction.
+"""
+
+from .chunking import plan_chunks
+from .pipelined import PipelinedEngine
+from .prefetch import ChunkPrefetcher, PrefetchExhausted
+
+__all__ = [
+    "PipelinedEngine", "ChunkPrefetcher", "PrefetchExhausted",
+    "plan_chunks",
+]

@@ -1,0 +1,33 @@
+"""Chunk planning for the pipelined execution engine.
+
+A chunk is a run of consecutive batches executed as ONE fused device
+dispatch (`Executor.build_chunked_train_step`). Chunks are sub-epoch:
+they never straddle an epoch boundary, so shuffle orders, RNG splits,
+and step counters line up exactly with the eager loop's — the epoch is
+simply covered by `ceil((num_batches - b0) / pipeline_steps)` dispatches
+instead of `num_batches - b0`.
+
+Checkpoint/preemption decisions happen only at chunk edges; the resume
+cursor therefore always lands on one (docs/performance.md).
+"""
+
+from __future__ import annotations
+
+
+def plan_chunks(b0: int, num_batches: int,
+                pipeline_steps: int) -> list[tuple[int, int]]:
+    """Cover batches [b0, num_batches) with chunks of up to
+    `pipeline_steps` steps. Returns [(start_batch, n_steps), ...]; the
+    final chunk absorbs the remainder (a shorter chunk costs one extra
+    compile per distinct size, cached by the executor)."""
+    if pipeline_steps < 1:
+        raise ValueError(f"pipeline_steps must be >= 1, got {pipeline_steps}")
+    if b0 < 0:
+        raise ValueError(f"b0 must be >= 0, got {b0}")
+    chunks = []
+    b = b0
+    while b < num_batches:
+        n = min(pipeline_steps, num_batches - b)
+        chunks.append((b, n))
+        b += n
+    return chunks

@@ -1,0 +1,254 @@
+"""Pipelined execution engine: fused multi-step dispatch for FFModel.fit.
+
+PERF.md round 5 measured the gap this closes: the eager per-step fit loop
+pays ~0.2-1.5 ms of per-dispatch overhead plus a synchronous host slice +
+`device_put` inside every step window, while bench.py's single fused scan
+loop (the TPU-native analog of the reference's Legion trace replay,
+PAPER.md §3) runs the same math at full device throughput. The engine
+brings `fit` onto the fused path without changing its semantics:
+
+  - **fused multi-step dispatch** — chunks of `pipeline_steps` train steps
+    compiled as one donated `lax.scan` over pre-staged batches
+    (Executor.build_chunked_train_step). Chunks are sub-epoch, the RNG
+    split sequence and step counters are identical to the eager loop's,
+    and the per-step loss rides out of the scan as a vector — training is
+    bit-identical to `pipeline_steps=1` (tested).
+  - **async input pipeline** — a ChunkPrefetcher thread slices the next
+    chunk's batches on host and `device_put`s them with the input's
+    NamedSharding while the current chunk runs on device; `data_wait`
+    collapses to a queue pop (Daydream's overlap what-if, PAPERS.md).
+  - **deferred metrics/health sync** — ONE device fetch per chunk (the
+    loss vector) replaces the per-step sync; telemetry gets per-step
+    records reconstructed from the chunk window (device time attributed
+    as chunk/N), and the diagnostics NaN/spike/drift rules evaluate per
+    step from the fetched vector.
+
+Periodic work (checkpoints, preemption drain, fault hooks) runs at chunk
+boundaries only — CheckFreq's cadence riding along without giving the
+overlap back — so the resume cursor always lands on a chunk edge.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .. import telemetry
+from .chunking import plan_chunks
+from .prefetch import ChunkPrefetcher
+
+
+@partial(jax.jit, static_argnums=1)
+def _split_chunk_rngs(rng, n: int):
+    """The eager loop's per-step `rng, sub = jax.random.split(rng)`
+    sequence, batched into one dispatch: returns (advanced rng, stacked
+    subs) with bit-identical keys."""
+
+    def body(r, _):
+        r, sub = jax.random.split(r)
+        return r, sub
+
+    return jax.lax.scan(body, rng, None, length=n)
+
+
+class PipelinedEngine:
+    """Drives one model's fit epochs in fused chunks. Constructed per fit
+    call (cheap: the chunked executables live in the executor's cache)."""
+
+    def __init__(self, model, pipeline_steps: int, prefetch_depth: int = 2):
+        if pipeline_steps < 2:
+            raise ValueError(
+                f"PipelinedEngine needs pipeline_steps >= 2, got "
+                f"{pipeline_steps} (use the eager loop for 1)")
+        self.model = model
+        self.pipeline_steps = int(pipeline_steps)
+        self.prefetch_depth = int(prefetch_depth)
+        # input/label shardings resolved ONCE per name (the eager path's
+        # per-batch graph.sources() scan, hoisted — via the same
+        # model._input_partition_spec the eager loop uses, so placement
+        # matches it exactly: unmatched names go mesh-REPLICATED, not to
+        # the default device). Leading None is the chunk's scan axis —
+        # batches stack along it unsharded.
+        self._input_shardings: dict = {}
+        self._label_sharding = NamedSharding(
+            model.mesh, PartitionSpec(None, *model.label_spec))
+
+    def _sharding_for(self, name: str) -> NamedSharding:
+        sh = self._input_shardings.get(name)
+        if sh is None:
+            spec = self.model._input_partition_spec(name)
+            sh = NamedSharding(
+                self.model.mesh,
+                PartitionSpec(None, *spec) if spec is not None
+                else PartitionSpec())
+            self._input_shardings[name] = sh
+        return sh
+
+    # ------------------------------------------------------------ staging
+
+    def _stage_chunk(self, x_dict: dict, y, order, start_b: int, n: int,
+                     batch_size: int):
+        """Host work for one chunk (runs on the prefetch thread): gather
+        the chunk's samples in epoch order, stack per-step batches along
+        the scan axis, and place them on the mesh."""
+        with telemetry.span("prefetch.stage", steps=n, start_batch=start_b):
+            lo = start_b * batch_size
+            idx = order[lo: lo + n * batch_size]
+            xs = {}
+            for name, v in x_dict.items():
+                arr = v[idx].reshape((n, batch_size) + v.shape[1:])
+                xs[name] = jax.device_put(arr, self._sharding_for(name))
+            yb = y[idx].reshape((n, batch_size) + y.shape[1:])
+            return xs, jax.device_put(yb, self._label_sharding)
+
+    # ------------------------------------------------------------ epoch
+
+    def run_epoch(self, *, x_dict: dict, y, order, b0: int,
+                  num_batches: int, batch_size: int, abs_e: int,
+                  py_step: int, tel, diag, resil, preempt, fault_hook,
+                  tokens_per_example: int) -> tuple[int, bool]:
+        """Run batches [b0, num_batches) of one epoch in fused chunks.
+        Mutates the model's training state in place (exactly like the
+        eager loop) and returns (py_step, preempted). HealthAbort and
+        SimulatedPreemption propagate to fit's handlers; the prefetch
+        thread is shut down on every exit path."""
+        model = self.model
+        chunks = plan_chunks(b0, num_batches, self.pipeline_steps)
+        if not chunks:
+            return py_step, False
+        prefetcher = ChunkPrefetcher(
+            lambda c: self._stage_chunk(
+                x_dict, y, order, c[0], c[1], batch_size),
+            chunks, depth=self.prefetch_depth)
+        # the loss vector is fetched once per chunk only when something
+        # consumes it (telemetry timing sync + diagnostics rules, both
+        # synthesized under tel); a bare fit dispatches chunks
+        # back-to-back with no host sync at all
+        need_losses = tel is not None
+        preempted = False
+        try:
+            for start_b, n in chunks:
+                t_chunk0 = time.perf_counter()
+                staged = prefetcher.get()
+                t_pop1 = time.perf_counter()
+                # a cache miss means THIS chunk's wall time includes the
+                # executable compile — its synthesized records must not
+                # feed the timing-based health/drift rules (the eager
+                # loop's step-1 compile is excluded by their warmup; a
+                # tail-chunk compile mid-run would not be)
+                compiled_now = n not in model.executor._chunk_steps
+                chunk_fn = model.executor.build_chunked_train_step(n)
+                model._rng, rngs = _split_chunk_rngs(model._rng, n)
+                with telemetry.span("chunk", steps=n, step0=py_step + 1):
+                    (
+                        model._params,
+                        model._state,
+                        model._opt_slots,
+                        model._step,
+                        model._counters,
+                        losses,
+                    ) = chunk_fn(
+                        model._params, model._state, model._opt_slots,
+                        model._step, model._counters, rngs, staged,
+                    )
+                    loss_host = (np.asarray(jax.device_get(losses))
+                                 if need_losses else None)
+                t_run1 = time.perf_counter()
+                py_step += n
+                end_b = start_b + n
+                # the cursor names the NEXT batch to run on resume —
+                # always a chunk edge; epochs are ABSOLUTE (since compile)
+                if end_b >= num_batches:
+                    cursor = {"epoch": abs_e + 1, "batch": 0}
+                else:
+                    cursor = {"epoch": abs_e, "batch": end_b}
+                if resil is not None:
+                    if preempt is not None and preempt.preempted:
+                        # preemption notice: the running chunk completed
+                        # (a dispatched scan cannot be interrupted), so
+                        # drain the in-flight async save and take the one
+                        # final synchronous snapshot at this chunk edge
+                        telemetry.instant("preempted", step=py_step)
+                        resil.finalize(py_step, cursor, final_save=True)
+                        preempted = True
+                    elif resil.policy.should_save_range(py_step - n,
+                                                        py_step):
+                        resil.save(py_step, cursor, blocking=False)
+                t_save1 = time.perf_counter()
+                if tel is not None:
+                    self._synthesize_step_records(
+                        tel=tel, diag=diag, resil=resil, n=n,
+                        step0=py_step - n + 1, abs_e=abs_e,
+                        t_chunk0=t_chunk0, t_pop1=t_pop1, t_run1=t_run1,
+                        t_save1=t_save1, loss_host=loss_host,
+                        batch_size=batch_size,
+                        tokens_per_example=tokens_per_example,
+                        compiled_now=compiled_now)
+                if fault_hook is not None:
+                    for s in range(py_step - n + 1, py_step + 1):
+                        fault_hook(s)
+                if preempted:
+                    telemetry.event("preempted", step=py_step)
+                    return py_step, True
+        finally:
+            prefetcher.shutdown()
+        return py_step, False
+
+    # ------------------------------------------------------------ telemetry
+
+    def _synthesize_step_records(self, *, tel, diag, resil, n: int,
+                                 step0: int, abs_e: int, t_chunk0: float,
+                                 t_pop1: float, t_run1: float,
+                                 t_save1: float,
+                                 loss_host: Optional[np.ndarray],
+                                 batch_size: int, tokens_per_example: int,
+                                 compiled_now: bool = False):
+        """Reconstruct per-step telemetry/diagnostics records from one
+        chunk's wall window so every downstream consumer (metrics.jsonl
+        schema, drift windows, health rules, run_doctor) keeps working
+        unchanged: device time is attributed as chunk_device/N, the queue
+        pop as the chunk's data_wait, the boundary save as its
+        save_latency — all spread evenly across the chunk's steps (their
+        sum reproduces the chunk wall time exactly)."""
+        data_wait = (t_pop1 - t_chunk0) / n
+        save_lat = (t_save1 - t_run1) / n
+        step_time = (t_save1 - t_chunk0) / n
+        if diag is not None and resil is not None:
+            # the staleness clock advances once per chunk (saves only
+            # happen at boundaries)
+            diag.note_checkpoint_commit(resil.last_commit_walltime())
+        for i in range(n):
+            step = step0 + i
+            t0 = t_chunk0 + i * step_time
+            # synthesized trace spans: Perfetto shows the same step/
+            # data_wait lanes as the eager loop, sliced from the chunk
+            tel.tracer.complete("step", t0, t0 + step_time, step=step,
+                                synthesized=True)
+            tel.tracer.complete("data_wait", t0, t0 + data_wait,
+                                synthesized=True)
+            tel.record_step(step, abs_e, step_time, data_wait, save_lat,
+                            batch_size, tokens_per_example)
+            if diag is not None:
+                # HealthAbort propagates from here mid-chunk: earlier
+                # steps of the chunk are already recorded, exactly like
+                # the eager loop stopping at the aborting step. A chunk
+                # that just compiled its executable reports loss only —
+                # its timings are compile-dominated and would seed the
+                # spike/drift baselines wrong (every timing rule skips
+                # None fields; the telemetry records above stay honest
+                # wall time, like the eager loop's step-1 record).
+                diag.on_step({
+                    "step": step, "epoch": abs_e, "t": time.time(),
+                    "step_time_s": None if compiled_now else step_time,
+                    "data_wait_s": None if compiled_now else data_wait,
+                    "save_latency_s": None if compiled_now else save_lat,
+                    "device_time_s": None if compiled_now else max(
+                        0.0, step_time - data_wait - save_lat),
+                    "loss": (float(loss_host[i])
+                             if loss_host is not None else None),
+                })

@@ -992,11 +992,22 @@ class FFModel:
 
     # ================================================== training API
 
+    def _input_partition_spec(self, name: str):
+        """PartitionSpec of the graph input named `name`, or None when no
+        OP_INPUT source carries that name (callers place replicated). The
+        ONE resolution point for input placement — the fit loop, the
+        dataloader, and the pipelined engine all go through here."""
+        for node in self.graph.sources():
+            if node.op_type == OT.OP_INPUT and node.name == name:
+                return node.outputs[0].partition_spec()
+        return None
+
     def _make_batch(self, x_arrays: dict, labels):
         specs = {}
-        for node in self.graph.sources():
-            if node.op_type == OT.OP_INPUT and node.name in x_arrays:
-                specs[node.name] = node.outputs[0].partition_spec()
+        for name in x_arrays:
+            spec = self._input_partition_spec(name)
+            if spec is not None:
+                specs[name] = spec
         xs = self.executor.shard_batch(x_arrays, specs)
         y = jax.device_put(
             labels, jax.sharding.NamedSharding(self.mesh, self.label_spec)
@@ -1161,16 +1172,24 @@ class FFModel:
 
     def fit(self, x: Union[np.ndarray, Sequence[np.ndarray], dict], y: np.ndarray,
             epochs: int = -1, batch_size: int = -1, shuffle: bool = True,
-            verbose: bool = True):
+            verbose: bool = True, pipeline_steps: Optional[int] = None):
         """Training loop (parity: flexflow_cffi.py:2058-2100), made
         preemption-safe: policy-gated async checkpoints between steps, a
         SIGTERM drain-and-final-snapshot path, and --auto-resume restart
         from the newest committed checkpoint's (epoch, batch) cursor.
 
+        With `pipeline_steps > 1` (or --pipeline-steps) the loop routes
+        through the pipelined execution engine (engine/): chunks of N
+        steps run as one donated lax.scan dispatch over batches a
+        background thread prefetched onto the mesh, with checkpoints/
+        preemption at chunk boundaries — bit-identical losses/params to
+        the default eager loop (docs/performance.md).
+
         With telemetry on (--telemetry-dir / enable_telemetry) every step
         emits a trace span and a JSONL record splitting wall time into
         data-wait vs device time plus the blocking slice of any checkpoint
-        save; `verbose=False` drops the epoch progress lines to debug
+        save (reconstructed per step from the chunk window in pipelined
+        mode); `verbose=False` drops the epoch progress lines to debug
         level (they also honor FF_LOG_LEVEL and emit on host 0 only)."""
         assert self._compiled, "call compile() before fit()"
         from . import telemetry
@@ -1210,7 +1229,20 @@ class FFModel:
         x_dict = self._as_input_dict(x)
         num_samples = y.shape[0]
         num_batches = num_samples // batch_size
-        step_fn = self.executor._train_step or self.executor.build_train_step()
+        if pipeline_steps is None:
+            pipeline_steps = self.config.pipeline_steps
+        pipeline_steps = max(1, int(pipeline_steps))
+        engine = None
+        step_fn = None
+        health_every = max(1, int(self.config.health_sample_every))
+        health_win = [0.0, 0.0, 0.0, 0]  # step/data-wait/save sums, count
+        if pipeline_steps > 1:
+            from .engine import PipelinedEngine
+
+            engine = PipelinedEngine(self, pipeline_steps)
+        else:
+            step_fn = (self.executor._train_step
+                       or self.executor.build_train_step())
 
         resil = self._resilience
         if resil is None and self.config.checkpoint_dir:
@@ -1301,7 +1333,28 @@ class FFModel:
                                     f"epoch", stacklevel=2)
                                 b0 = 0
                         self._resume_cursor = None
-                    for b in range(b0, num_batches):
+                    if engine is not None:
+                        # pipelined engine: fused chunk dispatches with
+                        # prefetch; raises HealthAbort/SimulatedPreemption
+                        # into the same handlers as the eager loop below
+                        py_step, preempted = engine.run_epoch(
+                            x_dict=x_dict, y=y, order=order, b0=b0,
+                            num_batches=num_batches,
+                            batch_size=batch_size, abs_e=abs_e,
+                            py_step=py_step, tel=tel, diag=diag,
+                            resil=resil, preempt=preempt,
+                            fault_hook=self._fault_hook,
+                            tokens_per_example=tokens_per_example)
+                        if preempted:
+                            fflog.warning(
+                                "preempted at step %d (chunk boundary): "
+                                "final checkpoint committed, stopping "
+                                "fit", py_step)
+                            return
+                        b0_eager = num_batches  # epoch fully covered
+                    else:
+                        b0_eager = b0
+                    for b in range(b0_eager, num_batches):
                         t_it0 = time.perf_counter() if tel is not None else 0.0
                         with telemetry.span("step", step=py_step + 1):
                             with telemetry.span("data_wait"):
@@ -1347,7 +1400,9 @@ class FFModel:
                         if tel is not None:
                             save_lat = time.perf_counter() - t_save0
                             loss_val = None
-                            if diag is not None:
+                            sampled = (diag is not None
+                                       and py_step % health_every == 0)
+                            if sampled:
                                 # the scalar loss fetch is a device sync
                                 # and happens ONLY with diagnostics on —
                                 # BEFORE step_time is read, so the drained
@@ -1365,25 +1420,41 @@ class FFModel:
                                 save_lat, batch_size, tokens_per_example)
                             if diag is not None:
                                 if resil is not None:
-                                    # checkpointer stamps commits on the
-                                    # monotonic clock; the staleness rule
-                                    # runs on wall time — convert
-                                    lc = resil.checkpointer._last_commit_t
-                                    if lc is not None:
-                                        diag.note_checkpoint_commit(
-                                            time.time()
-                                            - (time.monotonic() - lc))
-                                diag.on_step({
-                                    "step": py_step, "epoch": abs_e,
-                                    "t": time.time(),
-                                    "step_time_s": step_time,
-                                    "data_wait_s": data_wait,
-                                    "save_latency_s": save_lat,
-                                    "device_time_s": max(
-                                        0.0, step_time - data_wait
-                                        - save_lat),
-                                    "loss": loss_val,
-                                })
+                                    diag.note_checkpoint_commit(
+                                        resil.last_commit_walltime())
+                                # --health-sample-every K: with the drain
+                                # thinned to every K-th step, the steps in
+                                # between measure dispatch only while the
+                                # sampled step absorbs the drained device
+                                # work — feeding rules that raw bimodal
+                                # stream would seed spike/stall/drift
+                                # baselines on dispatch-only windows. So
+                                # rules see ONE record per window with
+                                # the K-step AVERAGE (the pipelined
+                                # chunk/N attribution applied to the
+                                # eager loop); K=1 reduces to the
+                                # per-step record exactly.
+                                hw = health_win
+                                hw[0] += step_time
+                                hw[1] += data_wait
+                                hw[2] += save_lat
+                                hw[3] += 1
+                                if sampled:
+                                    k = hw[3]
+                                    w_t, w_dw, w_sv = (hw[0] / k,
+                                                       hw[1] / k,
+                                                       hw[2] / k)
+                                    health_win = [0.0, 0.0, 0.0, 0]
+                                    diag.on_step({
+                                        "step": py_step, "epoch": abs_e,
+                                        "t": time.time(),
+                                        "step_time_s": w_t,
+                                        "data_wait_s": w_dw,
+                                        "save_latency_s": w_sv,
+                                        "device_time_s": max(
+                                            0.0, w_t - w_dw - w_sv),
+                                        "loss": loss_val,
+                                    })
                         if self._fault_hook is not None:
                             self._fault_hook(py_step)
                         if preempted:
@@ -1536,6 +1607,8 @@ class FFModel:
             return
         self.optimizer.set_learning_rate(lr)
         self.executor._train_step = None
+        # chunked executables bake in the same rate constant
+        self.executor._chunk_steps.clear()
 
     def get_perf_metrics(self) -> PerfMetrics:
         return PerfMetrics(jax.device_get(self._counters), self.metrics)

@@ -31,4 +31,5 @@ class RecompileState:
             ex._train_step = None
             ex._eval_step = None
             ex._forward_fn = None
+            ex._chunk_steps.clear()
         self.recompilations += 1

@@ -65,6 +65,19 @@ class ResilienceManager:
             extras=self._extras(step, cursor), blocking=blocking)
         self.policy.notify_saved()
 
+    def last_commit_walltime(self) -> Optional[float]:
+        """Wall-clock time of the newest committed checkpoint, or None
+        before the first commit. The checkpointer stamps commits on the
+        monotonic clock; the diagnostics staleness rule runs on wall
+        time — this is the ONE conversion point (eager fit loop and the
+        pipelined engine both feed `note_checkpoint_commit` from here)."""
+        import time
+
+        lc = self.checkpointer._last_commit_t
+        if lc is None:
+            return None
+        return time.time() - (time.monotonic() - lc)
+
     def finalize(self, step: Optional[int] = None,
                  cursor: Optional[dict] = None, final_save: bool = False):
         """Drain the in-flight async save; optionally write one last

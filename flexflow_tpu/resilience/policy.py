@@ -51,6 +51,23 @@ class CheckpointPolicy:
             return True
         return False
 
+    def should_save_range(self, start_step: int, end_step: int) -> bool:
+        """True when ANY step in (start_step, end_step] triggers the
+        policy — the pipelined engine's chunk-boundary form: a chunk that
+        ran steps 5..8 with every_n_steps=4 must still save, even though
+        the boundary step 8's modulus is the only one it could test."""
+        if end_step <= start_step:
+            return False
+        if (self.every_n_steps > 0
+                and end_step // self.every_n_steps
+                > start_step // self.every_n_steps):
+            return True
+        if (self.every_t_seconds > 0
+                and time.monotonic() - self._last_save_time
+                >= self.every_t_seconds):
+            return True
+        return False
+
     def notify_saved(self):
         self._last_save_time = time.monotonic()
 

@@ -29,7 +29,8 @@ _MANIFEST_CONFIG_FIELDS = (
     "search_mesh_shapes", "only_data_parallel", "enable_substitutions",
     "profiling", "computation_dtype", "checkpoint_dir", "checkpoint_every",
     "checkpoint_every_seconds", "auto_resume", "seed",
-    "diagnostics", "drift_threshold",
+    "diagnostics", "drift_threshold", "pipeline_steps",
+    "health_sample_every",
 )
 
 

@@ -105,6 +105,13 @@ class Tracer:
             ev["args"] = args
         self._append(ev)
 
+    def complete(self, name: str, t0: float, t1: float, **args):
+        """Record a completed span from explicit `perf_counter`
+        timestamps — for synthesized events whose window was not
+        measured by a live `with span(...)` block (the pipelined engine
+        reconstructs per-step spans from one chunk's wall window)."""
+        self._complete(name, t0, t1, args or None)
+
     def instant(self, name: str, **args):
         """Zero-duration marker (preemption notice, resume, best-cost)."""
         ev = {"name": name, "ph": "i", "s": "t",
