@@ -217,6 +217,85 @@ def _fit_loop_legs(cfg, batch: int, on_tpu: bool,
     }
 
 
+def _warmstart_legs() -> dict:
+    """Cold-vs-warm time-to-first-step against one fresh --warmstart-dir
+    (compile start → first optimizer step done — the restart latency the
+    warm-start subsystem exists to collapse, docs/performance.md "Warm
+    start & compile caching"). Archived in the BENCH payload so the
+    warm/cold ratio is tracked per round.
+
+    Both legs run in this process, so jax's in-memory compilation
+    memoization (keyed by HLO hash) is cleared between them — the warm
+    leg must be served by the ON-DISK layers (persistent XLA executable
+    cache + plan cache + calibration DB), exactly what a restarted
+    process would hit. Multi-chip fleets also exercise the plan cache
+    (search + calibration on the cold leg, fingerprint hit on the warm);
+    a single-device fleet has no search, so there the legs measure the
+    executable-cache layer alone."""
+    import tempfile
+    import time as _time
+
+    import jax
+
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, SGDOptimizer,
+    )
+
+    wdir = tempfile.mkdtemp(prefix="bench_warmstart_")
+    multi = jax.device_count() > 1
+    batch = 16
+
+    def leg(tag: str) -> float:
+        from flexflow_tpu import telemetry
+
+        jax.clear_caches()
+        config = FFConfig()
+        config.batch_size = batch
+        config.warmstart_dir = wdir
+        if multi:
+            config.search_budget = 4
+            config.enable_parameter_parallel = True
+            config.search_calibrate = 1
+        ff = FFModel(config)
+        # explicit names: default layer names embed a process-global guid
+        # counter, and the two legs' fingerprints must match
+        x = ff.create_tensor((batch, 256), name="ws_x")
+        t = x
+        for i in range(6):
+            t = ff.dense(t, 256, ActiMode.AC_MODE_RELU, name=f"ws_fc{i}")
+        ff.dense(t, 32, name="ws_head")
+        rs = np.random.RandomState(0)
+        X = rs.randn(batch, 256).astype(np.float32)
+        Y = rs.randint(0, 32, (batch, 1)).astype(np.int32)
+        with telemetry.span("bench.warmstart", leg=tag):
+            t0 = _time.perf_counter()
+            ff.compile(
+                optimizer=SGDOptimizer(lr=0.01),
+                loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+            # one optimizer step: first-step latency includes the train
+            # step's jit compile + first batch staging
+            ff.fit(X, Y, epochs=1, batch_size=batch, shuffle=False,
+                   verbose=False)
+            dt = _time.perf_counter() - t0
+        return dt
+
+    try:
+        cold = leg("cold")
+        warm = leg("warm")
+    finally:
+        # the dir only exists to connect the two legs; no compiles happen
+        # after these legs, so the (process-global) cache pointer going
+        # stale with it is harmless
+        import shutil
+
+        shutil.rmtree(wdir, ignore_errors=True)
+    return {
+        "cold_time_to_first_step_s": round(cold, 4),
+        "warm_time_to_first_step_s": round(warm, 4),
+        "speedup": round(cold / warm, 4) if warm > 0 else None,
+    }
+
+
 def main():
     # --telemetry-dir DIR: archive this run's host-side timeline + metrics
     # (trace.json / metrics.jsonl) so BENCH numbers come with forensics.
@@ -321,6 +400,21 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
     except Exception as e:  # pragma: no cover - defensive
         print(f"bench: fit-loop leg failed: {e}", file=sys.stderr)
 
+    # warm-start legs: cold-vs-warm time-to-first-step against one shared
+    # --warmstart-dir (secondary line + archived in the primary payload)
+    warmstart = None
+    try:
+        warmstart = _warmstart_legs()
+        print(json.dumps({
+            "metric": "warmstart_time_to_first_step_s",
+            "cold": warmstart["cold_time_to_first_step_s"],
+            "warm": warmstart["warm_time_to_first_step_s"],
+            "speedup": warmstart["speedup"],
+            "unit": "s",
+        }))
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"bench: warm-start leg failed: {e}", file=sys.stderr)
+
     # one payload feeds both the archived metrics record and the printed
     # line of record — they must never drift apart
     payload = {
@@ -331,6 +425,8 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
     }
     if fit_loop is not None:
         payload["fit_loop"] = fit_loop
+    if warmstart is not None:
+        payload["warmstart"] = warmstart
     if tokens_per_sec is None:
         # a physically impossible reading must never become the number of
         # record: emit null and fail so the driver records the fluke as a

@@ -134,6 +134,14 @@ class FFConfig:
     # 1 = the eager per-step loop (default; bit-identical trajectories
     # either way — docs/performance.md).
     pipeline_steps: int = 1
+    # warm start (warmstart/): persistent plan + calibration + executable
+    # caching under one directory — the second compile of the same job
+    # skips the Unity search (plan cache hit replayed through the
+    # import-strategy machinery), calibration only measures misses, and
+    # JAX's persistent compilation cache serves the XLA executables.
+    # Invalidation is conservative: any change to the graph, mesh,
+    # search-relevant config, device kind, or calibration data misses.
+    warmstart_dir: str = ""
     # eager-loop diagnostics loss fetch cadence: the per-step device_get
     # is a full device drain; K>1 samples it every K-th step and the
     # health/drift rules then see one K-step-AVERAGED record per window
@@ -321,6 +329,8 @@ class FFConfig:
             elif a == "--health-abort-on":
                 self.health_abort_on = tuple(
                     r.strip() for r in val().split(",") if r.strip())
+            elif a == "--warmstart-dir":
+                self.warmstart_dir = val()
             elif a == "--pipeline-steps":
                 self.pipeline_steps = int(val())
             elif a == "--health-sample-every":

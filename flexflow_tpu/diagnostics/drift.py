@@ -134,12 +134,30 @@ def make_recalibration_state(model, top_k: int = 4):
     from ..recompile import RecompileState
 
     def _alter(ff):
-        sr = getattr(ff, "_search_result", None)
+        # warm-started runs (plan cache / checkpoint / broadcast) carry no
+        # search result; the explain report reconstructed an equivalent
+        # (UnitySearch, choice) for the ADOPTED plan — use it, so drift
+        # recalibration works exactly on the runs that reload persisted
+        # calibration entries
+        sr = (getattr(ff, "_search_result", None)
+              or getattr(ff, "_replay_search", None))
         if sr is None:
             return
         us, choice = sr
-        us.cm.calibrate_graph(ff.graph, top_k=top_k)
+        # remeasure: the monitor fired BECAUSE the cached measurements no
+        # longer describe the device — refresh them, don't skip them
+        us.cm.calibrate_graph(ff.graph, top_k=top_k, remeasure=True)
         us.cm._cache.clear()
+        warm = getattr(ff, "_warmstart", None)
+        if warm is not None:
+            # persist the refreshed readings (coordinator-only inside
+            # save_from's caller contract): the stale DB entries were
+            # feeding the plan-cache fingerprint, so the next restart
+            # would otherwise reload them and re-fire drift forever
+            from ..distributed import is_coordinator
+
+            if is_coordinator():
+                warm.calibration_db.save_from(us.cm)
         t, _ = us.evaluate(choice)
         ff._predicted_step_s = t
         diag = getattr(ff, "_diagnostics", None)

@@ -165,6 +165,7 @@ def build_strategy_report(model) -> dict:
         us, choice = sr
         mode = "searched"
     else:
+        from ..search.substitution import _logical_assignment
         from ..search.unity import UnitySearch
 
         machine = machine_model_for_mesh(
@@ -172,17 +173,61 @@ def build_strategy_report(model) -> dict:
         opt_slots = (model.optimizer.num_slots
                      if model.optimizer is not None else 1)
         cm = CostModel(machine, opt_slots=opt_slots)
+        warm = getattr(model, "_warmstart", None)
+        if warm is not None:
+            # price the reconstruction with the SAME persisted calibration
+            # the cold search consumed — a roofline-only cm would arm the
+            # drift monitor with a mispriced makespan and fire spurious
+            # advisories on every warm restart of a --calibrate'd job
+            warm.calibration_db.load_into(cm)
         us = UnitySearch(model.graph, model.mesh, model.config, cm,
                          refine=False)
+        # a plan adopted WITHOUT a local search (warm-start cache,
+        # checkpoint manifest, import, multi-host broadcast) left no
+        # (UnitySearch, choice) behind — reconstruct the choice by
+        # matching each node's candidate configs against the placements
+        # the plan materialized onto the graph, so the report (and the
+        # drift monitor's predicted makespan) describes the plan that is
+        # actually RUNNING, not the data-parallel default
+        applied = bool(getattr(model, "_strategy", None))
+
+        def _sharded(specs: dict) -> dict:
+            # drop fully-replicated entries: an absent weight spec and
+            # PartitionSpec() mean the same placement
+            return {k: tuple(v) for k, v in specs.items()
+                    if any(e for e in tuple(v))}
+
         choice = {}
+        matched = 0
         for n in us.order:
             try:
                 cfgs = us.node_configs(n)
             except ValueError:
                 cfgs = []
-            if cfgs:
-                choice[n.guid] = cfgs[0]
-        mode = "dp_fallback"
+            if not cfgs:
+                continue
+            pick = cfgs[0]
+            if applied and n.outputs:
+                cur_out = tuple(_logical_assignment(n.outputs[0]))
+                cur_w = _sharded(dict(n.weight_axes))
+                best_score = 0
+                for cfg in cfgs:
+                    if tuple(cfg.out_assign) != cur_out:
+                        continue
+                    score = 1 + (_sharded(dict(cfg.weight_specs)) == cur_w)
+                    if score > best_score:
+                        best_score, pick = score, cfg
+                if best_score:
+                    matched += 1
+            choice[n.guid] = pick
+        mode = "replayed" if applied and matched else "dp_fallback"
+        # stash the reconstructed evaluation for the drift-recalibration
+        # hook (make_recalibration_state falls back to it): warm-started
+        # runs have _search_result=None, and without this the remeasure +
+        # DB-refresh path would be unreachable exactly on the runs that
+        # reload persisted calibration. Kept SEPARATE from _search_result
+        # so a second report build still labels the plan honestly.
+        model._replay_search = (us, choice)
 
     detail: list[dict] = []
     makespan, mem = us.evaluate(choice, collect=detail)
@@ -215,6 +260,11 @@ def build_strategy_report(model) -> dict:
     report = {
         "kind": "strategy_report",
         "mode": mode,
+        # where the applied plan came from (search|cache|checkpoint|
+        # import|manual|default|broadcast — warmstart/): a cache/
+        # checkpoint source means this compile ran ZERO search
+        # evaluations for it
+        "plan_source": getattr(model, "_plan_source", "none"),
         "mesh_axes": {k: int(v) for k, v in
                       getattr(model.mesh, "shape", {}).items()},
         "overlap_sync": bool(us.config.search_overlap_backward_update),
@@ -237,7 +287,8 @@ def render_markdown(report: dict) -> str:
     lines = ["# Strategy explain report", ""]
     mesh = ", ".join(f"{k}={v}" for k, v in report["mesh_axes"].items())
     lines += [
-        f"- mesh: `{mesh}`  ·  mode: {report['mode']}",
+        f"- mesh: `{mesh}`  ·  mode: {report['mode']}"
+        f"  ·  plan source: {report.get('plan_source', 'none')}",
         f"- **predicted step makespan: "
         f"{report['total_predicted_s'] * 1e3:.3f} ms** "
         f"(Σcompute {report['sum_compute_s'] * 1e3:.3f} ms, "

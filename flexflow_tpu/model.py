@@ -121,6 +121,15 @@ class FFModel:
         # and re-rank runner-up plans without re-running the search
         self._search_result = None
         self._predicted_step_s = None  # chosen plan's predicted makespan
+        # warm start (warmstart/): where the applied plan came from
+        # (search|cache|checkpoint|import|manual|default), the structural
+        # plan fingerprint, the WarmStartManager when --warmstart-dir is
+        # set, and the manifest-ready plan record checkpoints embed so
+        # --auto-resume can restore the plan without searching
+        self._plan_source = "none"
+        self._plan_fingerprint = None
+        self._warmstart = None
+        self._plan_record = None
 
     # ================================================== tensor creation
 
@@ -635,6 +644,10 @@ class FFModel:
                 # manifest FIRST — before any search events the body emits
                 tel.write_manifest(self)
             t_compile0 = time.perf_counter()
+            if tel is not None:
+                # time-to-first-step accounting: the fit summary reports
+                # first-step completion relative to this instant
+                tel.note_compile_start(t_compile0)
             with telemetry.span("compile"):
                 self._compile_impl(optimizer, loss_type, metrics, comp_mode)
             if tel is not None:
@@ -649,6 +662,8 @@ class FFModel:
                                for k, v in self.mesh.shape.items()},
                     strategy_nodes=sorted(self._strategy)
                     if self._strategy else [],
+                    plan_source=self._plan_source,
+                    plan_fingerprint=self._plan_fingerprint,
                 )
                 diag = self._maybe_enable_diagnostics()
                 if diag is not None:
@@ -725,13 +740,34 @@ class FFModel:
         # --- mesh + strategy
         self.mesh = build_mesh(self.config.mesh_shape())
         used_substitutions = False
-        if self._strategy is None and self.config.import_strategy_file:
+        if self.config.warmstart_dir and self._warmstart is None:
+            # attach the warm-start subsystem early: pointing JAX's
+            # persistent compilation cache under the warm-start dir must
+            # precede the first jit of this compile (executor build,
+            # init_variables) so those executables land in / load from it
+            from .warmstart import WarmStartManager
+
+            self._warmstart = WarmStartManager(
+                self, self.config.warmstart_dir)
+        if self._strategy is not None:
+            self._plan_source = "manual"  # set_strategy()
+        elif self.config.import_strategy_file:
             # replay a previously searched/exported plan instead of
-            # re-searching (--import-strategy, model.cc:3599-3608)
+            # re-searching (--import-strategy, model.cc:3599-3608) —
+            # validated against THIS graph and mesh first, so a stale
+            # plan fails loudly instead of silently degrading node by
+            # node to data parallel
             from .parallel.strategies import Strategy
 
-            self._strategy = Strategy.load(
-                self.config.import_strategy_file).overrides
+            imported = Strategy.load(self.config.import_strategy_file)
+            try:
+                imported.validate(g, self.mesh)
+            except ValueError as e:
+                raise ValueError(
+                    f"--import-strategy "
+                    f"{self.config.import_strategy_file}: {e}") from e
+            self._strategy = imported.overrides
+            self._plan_source = "import"
         n_devices = 1
         for v in self.mesh.shape.values():
             n_devices *= v
@@ -773,17 +809,60 @@ class FFModel:
             cost_model = CostModel(
                 machine, opt_slots=self.optimizer.num_slots)
 
+            _calibrated = [False]
+
             def _calibrate():
                 # measure the dominant ops on the local chip so the search
                 # costs candidates from measurements, not the mfu guess
-                # (Simulator::measure_operator_cost, model.cu:38-75)
-                if self.config.search_calibrate > 0:
-                    with telemetry.span("compile.calibrate"):
-                        cost_model.calibrate_graph(
-                            g, top_k=self.config.search_calibrate)
+                # (Simulator::measure_operator_cost, model.cu:38-75).
+                # Idempotent: the warm-start fingerprinting runs it before
+                # the search branches do, and it must not emit two spans.
+                if _calibrated[0] or self.config.search_calibrate <= 0:
+                    return
+                _calibrated[0] = True
+                with telemetry.span("compile.calibrate"):
+                    cost_model.calibrate_graph(
+                        g, top_k=self.config.search_calibrate)
+                    stats = getattr(cost_model, "calib_stats", None)
+                    if stats is not None:
+                        # measured-vs-cache-hit split (the calibration
+                        # twin of the search evals/cache_hits counters):
+                        # with a warm calibration DB, measured → 0 and
+                        # cache_hits → candidates — drift in that reuse
+                        # is visible per compile in metrics.jsonl
+                        telemetry.event(
+                            "calibrate",
+                            top_k=self.config.search_calibrate, **stats)
 
             tensor_to_out[self.layers[-1].outputs[0].tensor_guid][0]._is_logits = True
-            if jax.process_count() > 1:
+            restored = None
+            if jax.process_count() == 1:
+                # warm start: adopt a cached/checkpointed plan when its
+                # fingerprint matches everything this search would consume
+                # — a hit replays through the same strategy machinery
+                # --import-strategy uses, with ZERO search evaluations
+                from .warmstart import restore_plan
+
+                restored = restore_plan(self, g, cost_model, _calibrate)
+            if restored is not None:
+                overrides, plan_mesh_axes, source = restored
+                cur_axes = {k: int(v) for k, v in self.mesh.shape.items()}
+                if plan_mesh_axes and plan_mesh_axes != cur_axes:
+                    # a mesh-shape-searched plan carries its winning
+                    # factorization — rebuild the mesh it was found for
+                    from .machine import MeshShape
+
+                    ms = self.config.mesh_shape()
+                    sizes = {a: 1 for a in ms.axis_names}
+                    sizes.update(plan_mesh_axes)
+                    self.mesh = build_mesh(MeshShape(
+                        tuple(sizes[a] for a in ms.axis_names),
+                        ms.axis_names))
+                self._strategy = overrides
+                self._plan_source = source
+                self._search_result = None  # plan replayed, not searched
+                self._assign_strategy()
+            elif jax.process_count() > 1:
                 # multi-host: search on process 0 only, broadcast the plan,
                 # and apply it to the ORIGINAL graph on every process (the
                 # reference's search-on-GPU0 + serialize pattern,
@@ -795,14 +874,53 @@ class FFModel:
                 def _search():
                     # calibration only where its measurements are consumed
                     # (process 0) — the other hosts' device time is not
-                    # wasted on benchmarks whose results get discarded
+                    # wasted on benchmarks whose results get discarded.
+                    # Warm start also lives entirely on process 0: only
+                    # host 0 reads/writes the shared warm-start dir, and a
+                    # plan-cache hit reaches the other hosts through the
+                    # same broadcast a searched plan would
+                    from .parallel.strategies import Strategy
+                    from .telemetry import log as fflog
+                    from .warmstart import restore_plan, store_plan
+
+                    warm = restore_plan(self, g, cost_model, _calibrate)
+                    if warm is not None:
+                        cur = {k: int(v)
+                               for k, v in self.mesh.shape.items()}
+                        if warm[1] and warm[1] != cur:
+                            # the fleet's mesh is already built on every
+                            # process — a plan for a different
+                            # factorization cannot be adopted here; treat
+                            # as a miss rather than mis-apply it
+                            fflog.warning(
+                                "warmstart: cached plan's mesh %s != "
+                                "fleet mesh %s — re-searching",
+                                warm[1], cur)
+                            warm = None
+                    if warm is not None:
+                        self._plan_source = warm[2]
+                        return Strategy(warm[0])
                     _calibrate()
+                    orig_names = {n.name for n in g.topo_order()}
                     _, choice, us = joint_graph_optimize(
                         g, self.mesh, self.config, cost_model)
-                    return us.to_strategy(choice)
+                    strategy = us.to_strategy(choice)
+                    self._strategy = strategy.overrides
+                    store_plan(self, meta={"mode": "multihost",
+                                           "evals": us.evals},
+                               replay_names=orig_names)
+                    return strategy
 
                 with telemetry.span("compile.search", mode="multihost"):
                     self._strategy = run_search_on_host0(_search)
+                if self._plan_source == "none":
+                    # host 0 knows whether the plan was searched or served
+                    # warm; the other hosts only know it arrived over the
+                    # broadcast — label it that way rather than guessing
+                    from .distributed import is_coordinator
+
+                    self._plan_source = ("search" if is_coordinator()
+                                         else "broadcast")
                 self._assign_strategy()
                 self._search_result = None  # plan arrived as a broadcast
             elif self.config.search_mesh_shapes:
@@ -843,6 +961,7 @@ class FFModel:
                     machine_factory = lambda mesh: machine_model_from_file(  # noqa: E731
                         self.config.machine_model_file, mesh)
                 _calibrate()
+                orig_names = {n.name for n in g.topo_order()}
                 with telemetry.span("compile.search", mode="mesh_shapes"):
                     shape, g, choice, us, _ = search_mesh_shapes(
                         g, n_devices, self.config, axes=search_axes,
@@ -857,18 +976,47 @@ class FFModel:
                 self.graph = g
                 self._strategy = us.to_strategy(choice).overrides
                 self._search_result = (us, choice)
+                self._plan_source = "search"
                 used_substitutions = True
+                from .warmstart import store_plan
+
+                store_plan(self, meta={"mode": "mesh_shapes",
+                                       "evals": us.evals},
+                           replay_names=orig_names)
             else:
                 _calibrate()
+                orig_names = {n.name for n in g.topo_order()}
                 with telemetry.span("compile.search", mode="joint"):
                     g, choice, us = joint_graph_optimize(
                         g, self.mesh, self.config, cost_model)
                 self.graph = g
                 self._strategy = us.to_strategy(choice).overrides
                 self._search_result = (us, choice)
+                self._plan_source = "search"
                 used_substitutions = True
+                from .warmstart import store_plan
+
+                store_plan(self, meta={"mode": "joint", "evals": us.evals},
+                           replay_names=orig_names)
         else:
+            if self._plan_source == "none":
+                self._plan_source = "default"  # data-parallel fallback
             self._assign_strategy()
+        if self._plan_fingerprint is not None:
+            # manifest-ready plan record: every checkpoint this model
+            # writes carries the applied plan + its structural
+            # fingerprint, so --auto-resume restores the plan from the
+            # manifest (warmstart._checkpoint_plan) instead of paying a
+            # from-scratch search after the weights already loaded
+            from .parallel.strategies import Strategy
+
+            self._plan_record = {
+                "structural_fingerprint": self._plan_fingerprint,
+                "plan_source": self._plan_source,
+                "strategy": Strategy(self._strategy or {}).to_json(),
+                "mesh_axes": {k: int(v)
+                              for k, v in self.mesh.shape.items()},
+            }
         if self.config.export_strategy_file:
             # persist the plan in effect (searched or imported) for replay
             # (--export-strategy, model.cc:3599-3608); only the coordinator

@@ -41,7 +41,7 @@ class ResilienceManager:
 
     def _extras(self, step: int, cursor: Optional[dict]) -> dict:
         mesh = self.ffmodel.mesh
-        return {
+        extras = {
             # cursor epochs are ABSOLUTE (epochs completed since compile):
             # model.fit maps them back onto its within-call loop index and
             # keys the deterministic shuffle order on them
@@ -50,6 +50,15 @@ class ResilienceManager:
             "mesh_axes": {k: int(v) for k, v in mesh.shape.items()}
             if mesh is not None else {},
         }
+        plan = getattr(self.ffmodel, "_plan_record", None)
+        if plan:
+            # the applied parallelization plan + structural fingerprint:
+            # --auto-resume restores the plan from this manifest at
+            # compile (warmstart/), so recovery skips the search — the
+            # Gemini (SOSP'23) point that RECOVERY time, not checkpoint
+            # time, bounds effective goodput
+            extras["plan"] = plan
+        return extras
 
     def maybe_save(self, step: int, cursor: Optional[dict] = None) -> bool:
         """Policy-gated async save after optimizer step `step`."""

@@ -30,7 +30,7 @@ _MANIFEST_CONFIG_FIELDS = (
     "profiling", "computation_dtype", "checkpoint_dir", "checkpoint_every",
     "checkpoint_every_seconds", "auto_resume", "seed",
     "diagnostics", "drift_threshold", "pipeline_steps",
-    "health_sample_every",
+    "health_sample_every", "warmstart_dir",
 )
 
 
@@ -52,6 +52,10 @@ class TelemetrySession:
         self._last_summary_steps = -1
         self._dropped_warned = False
         self._closed = False
+        # time-to-first-step: compile start (note_compile_start) → first
+        # step completion, the cold-vs-warm restart metric (warmstart/)
+        self._compile_t0: Optional[float] = None
+        self._time_to_first_step: Optional[float] = None
 
     # ------------------------------------------------------------ manifest
 
@@ -94,12 +98,24 @@ class TelemetrySession:
 
     # ------------------------------------------------------------ steps
 
+    def note_compile_start(self, t: Optional[float] = None):
+        """Anchor for time_to_first_step_s (the first compile's start
+        wins — that is the cold-start instant a restart pays for)."""
+        if self._compile_t0 is None:
+            self._compile_t0 = time.perf_counter() if t is None else t
+
     def record_step(self, step: int, epoch: int, step_time: float,
                     data_wait: float, save_latency: float,
                     batch_size: int, tokens_per_example: int = 1):
         """One optimizer step's host-side timing split. `step_time` is
         wall-clock between step dispatches — with one step in flight it
         converges to true device step time under backpressure."""
+        if self._time_to_first_step is None and self._compile_t0 is not None:
+            # completion of the run's FIRST step relative to compile
+            # start: search + calibration + executor build + first-batch
+            # staging + the step itself — the restart latency warm start
+            # exists to collapse
+            self._time_to_first_step = time.perf_counter() - self._compile_t0
         self._step_times.append(step_time)
         self._ema = (step_time if self._ema is None
                      else 0.9 * self._ema + 0.1 * step_time)
@@ -137,6 +153,8 @@ class TelemetrySession:
             fields["tokens_per_sec"] = (
                 self._tokens / self._train_seconds
                 if self._train_seconds > 0 else 0.0)
+        if self._time_to_first_step is not None:
+            fields["time_to_first_step_s"] = self._time_to_first_step
         dropped = self.tracer.dropped
         if dropped:
             # a capped trace is NOT a complete trace: say so in the summary

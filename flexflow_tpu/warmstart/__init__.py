@@ -1,0 +1,39 @@
+"""Warm start: persistent plan / calibration / executable caching.
+
+Makes the second compile of the same job near-free (`--warmstart-dir`,
+docs/performance.md "Warm start & compile caching"):
+
+1. plan cache       — the searched Strategy + mesh shape, content-addressed
+                      by a fingerprint of everything the search consumed
+2. calibration DB   — persisted on-chip op measurements; calibration only
+                      measures misses
+3. executable cache — JAX's persistent compilation cache under the same
+                      directory (eager step AND the engine's chunked scans)
+
+Plus the `--auto-resume` fast path: the resilience checkpoint manifest
+records the plan + structural fingerprint, so a preempted run restores its
+plan here without searching — recovery time, not just checkpoint time,
+bounds effective goodput (Gemini, SOSP'23).
+"""
+
+from .calibration_db import CalibrationDB
+from .fingerprint import (
+    calibration_fingerprint,
+    full_fingerprint,
+    graph_signature,
+    structural_fingerprint,
+)
+from .manager import (
+    WarmStartManager,
+    enable_executable_cache,
+    restore_plan,
+    store_plan,
+)
+from .plan_cache import PlanCache
+
+__all__ = [
+    "CalibrationDB", "PlanCache", "WarmStartManager",
+    "enable_executable_cache", "restore_plan", "store_plan",
+    "graph_signature", "structural_fingerprint",
+    "calibration_fingerprint", "full_fingerprint",
+]

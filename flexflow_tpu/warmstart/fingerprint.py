@@ -1,0 +1,167 @@
+"""Plan fingerprints: content addresses for cached parallelization plans.
+
+The reference caches every measured operator cost inside the simulator
+keyed by (OperatorParameters, MachineView) precisely because re-measuring
+dominates search time (simulator.h:691-783); the warm-start subsystem
+extends the same idea to the whole compile: a searched plan is valid for
+exactly the inputs the search consumed, so those inputs — hashed — become
+the plan's content address. Alpa (OSDI'22) treats auto-parallelization
+output as an offline artifact for the same reason.
+
+Two fingerprints, two uses:
+
+- **structural** — graph signature (topology + op params + dtypes + weight
+  specs + tied-weight links), configured mesh shape, the search-relevant
+  FFConfig fields (with referenced files hashed by content), device kind,
+  and the cost-model constants (opt_slots, mfu). Deterministic across
+  process restarts, independent of any on-chip measurement — this is the
+  key under which the resilience checkpoint manifest records the plan, so
+  `--auto-resume` can re-adopt the interrupted run's exact plan without a
+  search even when calibration would re-measure different numbers.
+- **full** — structural + a hash of the calibration entries the cost model
+  holds for this graph's ops. The plan-cache key: calibration data feeding
+  the search is part of the plan's identity, so a recalibrated world (new
+  chip, new toolchain, refreshed measurements) conservatively misses.
+
+Invalidation is by construction: ANY component change → different address
+→ miss → fresh search. There is no partial matching.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+# FFConfig fields that steer the search (and therefore the plan). A field
+# added to the search MUST be added here, or two configs that search
+# differently would share a fingerprint — when in doubt, include it.
+_SEARCH_CONFIG_FIELDS = (
+    "search_budget", "search_alpha", "search_overlap_backward_update",
+    "only_data_parallel", "enable_sample_parallel",
+    "enable_parameter_parallel", "enable_attribute_parallel",
+    "enable_substitutions", "search_mesh_shapes", "search_calibrate",
+    "base_optimize_threshold", "perform_memory_search",
+    "search_num_nodes", "search_num_workers",
+    "num_nodes", "workers_per_node",
+    "computation_dtype", "allow_tensor_op_math_conversion",
+    "force_tensor_op_math",
+)
+
+
+def _sha(obj) -> str:
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True).encode()).hexdigest()
+
+
+def _file_digest(path: str) -> str:
+    """Content hash of a config-referenced file; referenced-but-missing is
+    its own distinct state (the compile would fail differently)."""
+    if not path:
+        return ""
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return f"missing:{os.path.basename(path)}"
+
+
+def device_signature() -> dict:
+    """The hardware the plan was searched (and calibrated) for."""
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+        return {
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", ""),
+            "device_count": jax.device_count(),
+        }
+    except Exception:
+        return {"platform": "unknown", "device_kind": "", "device_count": 0}
+
+
+def graph_signature(graph) -> list:
+    """JSON-able signature of a PCG: per-node (name, op type, params repr,
+    output shapes/dtypes, weight specs, tied-weight source) plus the edge
+    list in node-name space. Node names are part of the signature on
+    purpose: the cached Strategy is keyed by name, so differently-named
+    builds must not share a plan."""
+    sig = []
+    for node in graph.topo_order():
+        sig.append({
+            "name": node.name,
+            "op": node.op_type.name,
+            "params": repr(node.params),
+            "outputs": [
+                [list(pt.shape.logical_shape), pt.dtype.name]
+                for pt in node.outputs
+            ],
+            "weights": [
+                [ws.name, list(ws.shape), ws.dtype.name, bool(ws.trainable)]
+                for ws in node.weight_specs
+            ],
+            "tied": getattr(node, "weight_source", "") or "",
+            "in": sorted(
+                [graph.nodes[e.src].name, e.src_idx, e.dst_idx]
+                for e in graph.in_edges[node.guid]
+            ),
+        })
+    return sig
+
+
+def config_signature(config) -> dict:
+    sig = {}
+    for name in _SEARCH_CONFIG_FIELDS:
+        v = getattr(config, name, None)
+        if not isinstance(v, (bool, int, float, str, type(None))):
+            v = str(v)
+        sig[name] = v
+    sig["substitution_json"] = _file_digest(
+        config.substitution_json_path or "")
+    sig["machine_model_file"] = _file_digest(config.machine_model_file)
+    return sig
+
+
+def structural_fingerprint(graph, mesh_axes: dict, config,
+                           opt_slots: int = 1, mfu: float = 0.4) -> str:
+    """Measurement-free plan identity (see module docstring)."""
+    return _sha({
+        "v": 1,
+        "graph": graph_signature(graph),
+        "mesh": {k: int(v) for k, v in mesh_axes.items()},
+        "config": config_signature(config),
+        "device": device_signature(),
+        "opt_slots": int(opt_slots),
+        "mfu": repr(float(mfu)),
+    })
+
+
+def calibration_fingerprint(cost_model, graph) -> str:
+    """Hash of the calibration entries the search would consume for this
+    graph (restricted to the graph's ops — unrelated DB entries must not
+    churn the address). repr() keeps full float precision."""
+    from ..search.cost_model import _params_key
+    from .calibration_db import serialize_key
+
+    entries = []
+    seen = set()
+    for node in graph.topo_order():
+        if not node.inputs or not node.outputs:
+            continue
+        key = _params_key(node)
+        if key in seen:
+            continue
+        seen.add(key)
+        cal = cost_model._calibration.get(key)
+        if cal is not None:
+            entries.append([serialize_key(key), repr(cal[0]), repr(cal[1])])
+    entries.sort()
+    return _sha({"v": 1, "calibration": entries})
+
+
+def full_fingerprint(structural: str, calibration: str) -> str:
+    """The plan-cache address: structure AND the measurements that priced
+    the candidates."""
+    return hashlib.sha256(
+        f"{structural}:{calibration}".encode()).hexdigest()
