@@ -296,6 +296,64 @@ def _warmstart_legs() -> dict:
     }
 
 
+def _serving_legs(cfg, on_tpu: bool) -> dict:
+    """Serving leg: requests/s/chip + decode tokens/s/chip through the
+    continuous-batching engine (serving/) — the ROADMAP's "millions of
+    users" metric next to the training slope. The engine compiles the
+    decode graph from the same PCG, then drains a synthetic request queue
+    (prompt 8, 16 new tokens each) through a fixed slot set; the decode
+    executables are warmed by one throwaway request so the measured drain
+    is steady-state continuous batching. scripts/serve_bench.py is the
+    standalone, load-tunable twin."""
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu import telemetry
+    from flexflow_tpu.models import TransformerLMConfig, build_transformer_lm
+
+    if on_tpu:
+        n_requests, slots, prompt_len, max_new = 32, 8, 8, 16
+    else:
+        cfg = TransformerLMConfig(
+            vocab_size=256, hidden_size=64, num_heads=2, num_layers=1,
+            sequence_length=64, attention_impl="xla")
+        n_requests, slots, prompt_len, max_new = 8, 4, 8, 8
+    config = FFConfig()
+    config.batch_size = slots
+    if on_tpu:
+        from flexflow_tpu.fftype import DataType
+
+        config.computation_dtype = DataType.DT_BFLOAT16
+    ff = FFModel(config)
+    build_transformer_lm(ff, cfg, batch_size=slots)
+    with telemetry.span("bench.serve.compile"):
+        ff.compile(optimizer=SGDOptimizer(lr=0.01),
+                   loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        engine = ff.serve(slots=slots, max_new_tokens=max_new,
+                          prefill_chunk=8)
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(n_requests)]
+    with telemetry.span("bench.serve.warmup"):
+        engine.generate(prompts[:1])  # compile buckets + decode step
+    engine.reset_stats()
+    for p in prompts:
+        engine.submit(p)
+    with telemetry.span("bench.serve.measure", requests=n_requests):
+        engine.run_until_drained()
+    stats = engine.stats()
+    return {
+        "requests_per_sec_per_chip": round(
+            stats.get("requests_per_sec_per_chip", 0.0), 4),
+        "decode_tokens_per_sec_per_chip": round(
+            stats.get("decode_tokens_per_sec_per_chip", 0.0), 2),
+        "requests": stats["requests_completed"],
+        "slots": slots,
+        "max_new_tokens": max_new,
+        "ttft_p50_s": round(stats.get("ttft_p50_s", 0.0), 4),
+    }
+
+
 def main():
     # --telemetry-dir DIR: archive this run's host-side timeline + metrics
     # (trace.json / metrics.jsonl) so BENCH numbers come with forensics.
@@ -400,6 +458,25 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
     except Exception as e:  # pragma: no cover - defensive
         print(f"bench: fit-loop leg failed: {e}", file=sys.stderr)
 
+    # serving leg: requests/s/chip + decode tokens/s/chip through the
+    # continuous-batching engine, as secondary lines + a `serving` field
+    # in the primary payload
+    serving = None
+    try:
+        serving = _serving_legs(cfg, on_tpu)
+        print(json.dumps({
+            "metric": "serving_requests_per_sec_per_chip",
+            "value": serving["requests_per_sec_per_chip"],
+            "unit": "req/s",
+        }))
+        print(json.dumps({
+            "metric": "serving_decode_tokens_per_sec_per_chip",
+            "value": serving["decode_tokens_per_sec_per_chip"],
+            "unit": "tokens/s",
+        }))
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"bench: serving leg failed: {e}", file=sys.stderr)
+
     # warm-start legs: cold-vs-warm time-to-first-step against one shared
     # --warmstart-dir (secondary line + archived in the primary payload)
     warmstart = None
@@ -425,6 +502,8 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
     }
     if fit_loop is not None:
         payload["fit_loop"] = fit_loop
+    if serving is not None:
+        payload["serving"] = serving
     if warmstart is not None:
         payload["warmstart"] = warmstart
     if tokens_per_sec is None:

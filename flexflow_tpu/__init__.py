@@ -32,6 +32,7 @@ from .metrics import Metrics, PerfMetrics
 from .model import FFModel
 from . import parallel  # registers parallel-op OpDefs
 from . import resilience  # checkpointing / elastic resume / preemption
+from . import serving  # decode-graph inference + continuous batching
 from . import telemetry  # tracer + run metrics + leveled logging
 from .parallel import Strategy
 from .optimizer import AdamOptimizer, Optimizer, SGDOptimizer

@@ -142,6 +142,14 @@ class FFConfig:
     # Invalidation is conservative: any change to the graph, mesh,
     # search-relevant config, device kind, or calibration data misses.
     warmstart_dir: str = ""
+    # serving engine (serving/): defaults for model.serve() — the fixed
+    # continuous-batching slot count, the KV-cache length (0 → the model's
+    # training sequence length), and the prefill chunk width (prompts are
+    # processed through the decode graph in power-of-two length buckets up
+    # to this, each bucket one cached executable).
+    serve_slots: int = 4
+    serve_max_seq_len: int = 0
+    serve_prefill_chunk: int = 16
     # eager-loop diagnostics loss fetch cadence: the per-step device_get
     # is a full device drain; K>1 samples it every K-th step and the
     # health/drift rules then see one K-step-AVERAGED record per window
@@ -335,6 +343,12 @@ class FFConfig:
                 self.pipeline_steps = int(val())
             elif a == "--health-sample-every":
                 self.health_sample_every = int(val())
+            elif a == "--serve-slots":
+                self.serve_slots = int(val())
+            elif a == "--serve-max-seq":
+                self.serve_max_seq_len = int(val())
+            elif a == "--serve-prefill-chunk":
+                self.serve_prefill_chunk = int(val())
             elif a == "--synthetic-input":
                 self.synthetic_input = True
             elif a == "--allow-tensor-op-math-conversion":

@@ -100,6 +100,7 @@ class Executor:
         self._train_step = None
         self._eval_step = None
         self._forward_fn = None
+        self._decode_step = None  # serving decode executable (serving/)
         # chunked (lax.scan) train steps keyed by chunk length — the
         # pipelined engine's fused multi-step dispatch (engine/)
         self._chunk_steps: dict[int, Any] = {}
@@ -332,6 +333,38 @@ class Executor:
 
         self._eval_step = jax.jit(eval_step, donate_argnums=_donate_argnums((2,)))
         return self._eval_step
+
+    def build_decode_step(self):
+        """ONE serving iteration as a donated executable: forward the
+        decode graph (incremental attention reads+writes the KV-cache
+        state threaded through `state`), then sample the next token per
+        slot from the logits row `read_idx` names — argmax where
+        `temperature[slot] == 0`, Gumbel sampling otherwise, in the same
+        program so only the (slots,) token vector crosses the host
+        boundary. Donating `state` updates the cache in place on backends
+        that support donation (the TPU serving hot loop allocates nothing
+        per token). Distinct q_len values (decode=1, prefill buckets)
+        retrace into their own cached executables — the length-bucketed
+        executable set falls out of jit's shape specialization."""
+
+        def decode_step(params, state, x_inputs, read_idx, rng, temperature):
+            logits, new_state, _ = self._apply(
+                self._cast_compute(params), state,
+                self._cast_compute(x_inputs), training=False, rng=None,
+            )
+            slots = logits.shape[0]
+            sel = logits[jnp.arange(slots), read_idx]  # (slots, vocab)
+            sel = sel.astype(jnp.float32)
+            t = temperature.astype(jnp.float32)[:, None]
+            gumbel = jax.random.gumbel(rng, sel.shape, jnp.float32)
+            noisy = jnp.where(t > 0.0,
+                              sel / jnp.maximum(t, 1e-6) + gumbel, sel)
+            next_tok = jnp.argmax(noisy, axis=-1).astype(jnp.int32)
+            return self._restore_state_dtypes(new_state), next_tok
+
+        self._decode_step = jax.jit(
+            decode_step, donate_argnums=_donate_argnums((1,)))
+        return self._decode_step
 
     def build_forward(self):
         def forward(params, state, x_inputs, training):

@@ -196,6 +196,10 @@ class OperatorType(enum.IntEnum):
     OP_PRELU = enum.auto()
     OP_GELU = enum.auto()
     OP_MULTIHEAD_ATTENTION = enum.auto()
+    # incremental (decode-phase) self-attention over a stateful KV cache —
+    # the serving-engine op the reference snapshot predates (its later
+    # serving rewrite added IncMultiHeadSelfAttention; PAPER.md §0)
+    OP_INC_MULTIHEAD_ATTENTION = enum.auto()
     OP_FUSED = enum.auto()
     OP_RSQRT = enum.auto()
     OP_POW = enum.auto()

@@ -799,6 +799,184 @@ def flash_attention_packed(
                          block_q, block_k)
 
 
+# --------------------------------------------------------- decode (q_len=1)
+# Serving's hot path: ONE new query row per slot attending over that slot's
+# KV cache rows [0, length). The kernel is a degenerate flash forward —
+# grid (slots, heads, kv-blocks), a (1, block_k) logits stripe, online
+# softmax carried in VMEM — with the causal mask replaced by a per-slot
+# LENGTH mask (key_pos < length), since cache rows past the slot's cursor
+# hold stale garbage from earlier residents of the slot. Dead kv blocks
+# (entirely past the cursor) are skipped, so a nearly-empty cache costs
+# O(length), not O(max_seq). Like the packed training kernel, q/k/v stay in
+# the (slots, seq, heads·head_dim) projection layout — heads are selected
+# by lane-offset block index maps, no head transpose touches HBM.
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *refs,
+                   scale: float, block_k: int, seq_k: int, nj: int):
+    if nj == 1:
+        m_ref = l_ref = acc_ref = None
+    else:
+        m_ref, l_ref, acc_ref = refs
+    j = pl.program_id(2)
+    length = len_ref[0, 0]
+
+    @pl.when(j == 0)
+    def _init():
+        if nj > 1:
+            m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def step():
+        q = q_ref[0]  # (1, d)
+        k = k_ref[0]  # (block_k, d)
+        v = v_ref[0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (1, block_k)
+        key_pos = jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1) + j * block_k
+        mask = key_pos < length
+        logits = jnp.where(mask, logits, NEG_INF)
+        # zero masked V rows: stale cache rows can hold anything (NaN in
+        # interpret mode) and 0·NaN would poison the contraction
+        v = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+            + j * block_k < length, v, 0.0)
+        if nj == 1:
+            m = logits.max(axis=-1)
+            p = jnp.exp(logits - m[:, None])
+            l = p.sum(axis=-1)
+            acc = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # length == 0 (empty slot) ⇒ l == 0; clamp keeps the dead row
+            # finite (its output is never consumed) without touching live
+            # rows, whose l >= exp(0) = 1
+            o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(
+                o_ref.dtype)
+        else:
+            m_prev = m_ref[...]
+            m_new = jnp.maximum(m_prev, logits.max(axis=-1))
+            p = jnp.exp(logits - m_new[:, None])
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1)
+            acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[...] = m_new
+
+    if nj == 1:
+        step()
+        return
+    # live ⇔ the block's first key is inside [0, length)
+    pl.when(j * block_k < length)(step)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...]
+                    / jnp.maximum(l_ref[...], 1e-30)[:, None]).astype(
+                        o_ref.dtype)
+
+
+def decode_attention_reference(q, k, v, positions, *, num_heads: int,
+                               scale: float | None = None):
+    """Reference einsum attention over a KV cache — the CPU serving path
+    and the decode kernel's numerics oracle. q: (slots, q_len, H·hd) new
+    queries, k/v: (slots, S, H·hd) cache (new rows already written),
+    positions: (slots, q_len) int32 absolute position of each query row.
+    Query row i attends cache rows [0, positions[s, i]] — intra-chunk
+    causality during prefill falls out of the per-row positions. Same
+    where(-1e30)/softmax convention as sdpa_xla, so greedy decode is
+    token-identical to the teacher-forced training forward."""
+    slots, q_len, e = q.shape
+    s_k = k.shape[1]
+    h = num_heads
+    d = e // h
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    def split(t, s):
+        return t.reshape(slots, s, h, d).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = split(q, q_len), split(k, s_k), split(v, s_k)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32)
+    logits = logits * scale
+    key_pos = jnp.arange(s_k, dtype=jnp.int32)
+    mask = key_pos[None, None, None, :] <= positions[:, None, :, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return out.transpose(0, 2, 1, 3).reshape(slots, q_len, e)
+
+
+def flash_decode_attention(
+    q, k, v, lengths, *, num_heads: int, scale: float | None = None,
+    block_k: int = 512, interpret: bool | None = None,
+):
+    """Single-query decode attention on the packed layout. q: (slots, 1,
+    H·hd), k/v: (slots, S, H·hd) cache, lengths: (slots,) int32 live-key
+    counts (query at position p attends p+1 keys). Shapes the kernel can't
+    tile on hardware (head_dim not lane-aligned, tiny caches) fall back to
+    the reference einsum — the serving op routes CPU meshes there
+    directly, so tier-1 exercises serving without Pallas."""
+    slots, q_len, e = q.shape
+    if q_len != 1:
+        raise ValueError(f"decode kernel is single-query (got q_len={q_len})")
+    s_k = k.shape[1]
+    d = e // num_heads
+    if e % num_heads != 0:
+        raise ValueError(f"embed dim {e} % heads {num_heads} != 0")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # Mosaic lane rule (see flash_attention_packed): head selection by lane
+    # offset needs head_dim % 128 == 0 on hardware; small caches aren't
+    # worth a kernel launch anywhere
+    lane_ok = d % 128 == 0 or num_heads == 1 or interpret
+    if s_k < 128 or not lane_ok:
+        positions = (lengths.astype(jnp.int32) - 1)[:, None]
+        return decode_attention_reference(q, k, v, positions,
+                                          num_heads=num_heads, scale=scale)
+    bk = min(block_k, s_k)
+    nj = pl.cdiv(s_k, bk)
+    # scalar per-slot length rides a lane-aligned stripe, like the row
+    # stats in the training kernels (LSE_LANES trick)
+    len_b = jnp.broadcast_to(
+        lengths.astype(jnp.int32)[:, None], (slots, LSE_LANES))
+    qspec = pl.BlockSpec((1, 1, d), lambda s, h, j: (s, 0, h))
+    kspec = pl.BlockSpec((1, bk, d), lambda s, h, j: (s, j, h))
+    lspec = pl.BlockSpec((1, LSE_LANES), lambda s, h, j: (s, 0))
+    scratch_shapes = []
+    if nj > 1:
+        scratch_shapes = [
+            pltpu.VMEM((1,), jnp.float32),
+            pltpu.VMEM((1,), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ]
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, block_k=bk,
+                          seq_k=s_k, nj=nj),
+        grid=(slots, num_heads, nj),
+        in_specs=[qspec, kspec, kspec, lspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((slots, 1, e), q.dtype),
+        scratch_shapes=scratch_shapes,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+        name="flash_attention_decode",
+    )(q, k, v, len_b)
+    return out
+
+
 def flash_attention(
     q, k, v, *, causal: bool = False, scale: float | None = None,
     block_q: int = 512, block_k: int = 512,

@@ -417,6 +417,31 @@ class FFModel:
         return self._add_layer(OT.OP_MULTIHEAD_ATTENTION, p, [query, key, value],
                                name, inits, query.dtype).outputs[0]
 
+    def inc_multihead_attention(
+        self,
+        input: Tensor,
+        positions: Tensor,
+        embed_dim: int,
+        num_heads: int,
+        max_seq_len: int,
+        use_bias: bool = True,
+        impl: str = "auto",
+        name: str = "",
+    ) -> Tensor:
+        """Decode-phase self-attention over a per-layer KV cache (serving/):
+        `input` carries q_len new tokens per slot, `positions` their
+        absolute sequence positions (scratch-row convention for padding —
+        ops/inc_attention.py). The cache is a non-trainable stateful
+        weight, placed by the plan like any parameter. Weight names match
+        multihead_attention's, so trained parameters transfer by name."""
+        from .ops import IncMultiHeadAttentionParams
+
+        p = IncMultiHeadAttentionParams(embed_dim, num_heads, max_seq_len,
+                                        use_bias, impl)
+        return self._add_layer(OT.OP_INC_MULTIHEAD_ATTENTION, p,
+                               [input, positions], name,
+                               data_type=input.dtype).outputs[0]
+
     def concat(self, tensors: Sequence[Tensor], axis: int, name: str = "") -> Tensor:
         p = ConcatParams(axis, len(tensors))
         return self._add_layer(OT.OP_CONCAT, p, list(tensors), name,
@@ -1123,6 +1148,21 @@ class FFModel:
                         # multi-host meshes compose (dcn, data) on the batch
                         assignment[0] = batch_axes
                     pt.assign_axes(tuple(assignment))
+            if (node.op_type == OT.OP_INC_MULTIHEAD_ATTENTION
+                    and batch_deg > 1):
+                # default KV-cache placement: the slot dim rides the data
+                # axes with the batch it serves — a replicated cache would
+                # multiply per-chip HBM by the data degree. A searched/
+                # imported plan (e.g. head-parallel attention also sharding
+                # the cache feature dim over `model`) overrides below.
+                for ws in node.weight_specs:
+                    if not ws.trainable and ws.shape[0] % batch_deg == 0:
+                        node.weight_axes.setdefault(
+                            ws.name,
+                            PartitionSpec(
+                                batch_axes[0] if len(batch_axes) == 1
+                                else tuple(batch_axes),
+                                *([None] * (len(ws.shape) - 1))))
             if (node.op_type == OT.OP_PIPE_BLOCKS
                     and self.mesh.shape.get(AXIS_PIPE, 1) > 1):
                 # default pipe-axis sharding of the stacked block weights:
@@ -1785,6 +1825,22 @@ class FFModel:
         from .dataloader import SingleDataLoader
 
         return SingleDataLoader(self, batch_tensor, full_array)
+
+    # ------------------------------------------------ serving (serving/)
+
+    def serve(self, **kwargs):
+        """Build a ServingEngine on this trained model: compiles the
+        single-token *decode* graph from the same PCG (causal attention
+        becomes incremental attention over sharded KV-cache state, priced
+        and placed by the same Unity search + warm-start plan cache the
+        trainer uses), adopts this model's weights by name, and runs
+        Orca-style continuous batching over a fixed slot set
+        (docs/serving.md). kwargs override ServingSpec fields — slots,
+        max_seq_len, prefill_chunk, config_overrides, strategy, ..."""
+        assert self._compiled, "call compile() before serve()"
+        from .serving import ServingEngine
+
+        return ServingEngine(self, **kwargs)
 
     # ------------------------------------------------ checkpoint / export
 

@@ -16,6 +16,7 @@ from .transformer import (
     TransformerLMConfig,
     build_transformer,
     build_transformer_lm,
+    build_transformer_lm_decode,
     build_transformer_lm_pipelined,
 )
 from .xdl import build_xdl

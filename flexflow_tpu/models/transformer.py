@@ -77,6 +77,27 @@ class TransformerLMConfig:
     attention_impl: str = "flash"  # xla | flash | ring
 
 
+def _lm_trunk(ff, c: TransformerLMConfig, h, attention):
+    """The pre-LN block stack + final norm + vocab head, shared between
+    the training builder and the causal-decode builder — ONE graph
+    definition, two attention lowerings (`attention(x, name)` supplies
+    either training MHA or incremental KV-cache attention). Layer names
+    are identical on both paths, so trained parameters transfer to the
+    decode graph by name (serving/decode_graph.adopt_params)."""
+    for i in range(c.num_layers):
+        p = f"l{i}_"
+        a = ff.layer_norm(h, [2], name=f"{p}ln1")
+        a = attention(a, f"{p}attn")
+        h = ff.add(h, a, name=f"{p}res1")
+        m = ff.layer_norm(h, [2], name=f"{p}ln2")
+        m = ff.dense(m, c.mlp_ratio * c.hidden_size, name=f"{p}ffn1")
+        m = ff.gelu(m, name=f"{p}gelu")
+        m = ff.dense(m, c.hidden_size, name=f"{p}ffn2")
+        h = ff.add(h, m, name=f"{p}res2")
+    h = ff.layer_norm(h, [2], name="ln_f")
+    return ff.dense(h, c.vocab_size, use_bias=False, name="lm_head")
+
+
 def build_transformer_lm(ff, config: TransformerLMConfig | None = None,
                          batch_size: int | None = None):
     """Returns (tokens_input, logits). Loss:
@@ -90,22 +111,47 @@ def build_transformer_lm(ff, config: TransformerLMConfig | None = None,
                            name="positions")
     hp = ff.embedding(pos, c.sequence_length, c.hidden_size, name="wpe")
     h = ff.add(h, hp, name="embed_add")
-    for i in range(c.num_layers):
-        p = f"l{i}_"
-        a = ff.layer_norm(h, [2], name=f"{p}ln1")
-        a = ff.multihead_attention(
+
+    def attention(a, name):
+        return ff.multihead_attention(
             a, a, a, c.hidden_size, c.num_heads, causal=True,
-            impl=c.attention_impl, name=f"{p}attn",
+            impl=c.attention_impl, name=name,
         )
-        h = ff.add(h, a, name=f"{p}res1")
-        m = ff.layer_norm(h, [2], name=f"{p}ln2")
-        m = ff.dense(m, c.mlp_ratio * c.hidden_size, name=f"{p}ffn1")
-        m = ff.gelu(m, name=f"{p}gelu")
-        m = ff.dense(m, c.hidden_size, name=f"{p}ffn2")
-        h = ff.add(h, m, name=f"{p}res2")
-    h = ff.layer_norm(h, [2], name="ln_f")
-    logits = ff.dense(h, c.vocab_size, use_bias=False, name="lm_head")
+
+    logits = _lm_trunk(ff, c, h, attention)
     return tokens, logits
+
+
+def build_transformer_lm_decode(ff, config: TransformerLMConfig | None = None,
+                                slots: int | None = None,
+                                max_seq_len: int | None = None,
+                                impl: str = "auto"):
+    """The flagship LM's *decode* graph, built directly (the model-zoo
+    twin of serving/decode_graph's generic replay): single-token query per
+    continuous-batching slot, per-layer KV caches written at the
+    position-indexed rows the `positions` input names. Same `_lm_trunk`,
+    same layer names — a model trained with `build_transformer_lm` feeds
+    this graph its weights unchanged. Returns (tokens, positions, logits);
+    compile with CompMode.COMP_MODE_INFERENCE."""
+    c = config or TransformerLMConfig()
+    n = slots or ff.config.serve_slots
+    max_seq = max_seq_len or c.sequence_length
+    tokens = ff.create_tensor((n, 1), DataType.DT_INT32, create_grad=False,
+                              name="tokens")
+    h = ff.embedding(tokens, c.vocab_size, c.hidden_size, name="wte")
+    pos = ff.create_tensor((n, 1), DataType.DT_INT32, create_grad=False,
+                           name="positions")
+    hp = ff.embedding(pos, c.sequence_length, c.hidden_size, name="wpe")
+    h = ff.add(h, hp, name="embed_add")
+
+    def attention(a, name):
+        return ff.inc_multihead_attention(
+            a, pos, c.hidden_size, c.num_heads, max_seq, impl=impl,
+            name=name,
+        )
+
+    logits = _lm_trunk(ff, c, h, attention)
+    return tokens, pos, logits
 
 
 def build_transformer_lm_pipelined(ff, config: TransformerLMConfig | None = None,

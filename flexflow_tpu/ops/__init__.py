@@ -5,6 +5,7 @@ from . import elementwise  # noqa: F401
 from . import core  # noqa: F401
 from . import shape_ops  # noqa: F401
 from . import attention  # noqa: F401
+from . import inc_attention  # noqa: F401
 from . import moe  # noqa: F401
 from . import pipeline_blocks  # noqa: F401
 
@@ -20,6 +21,7 @@ from .core import (
     SoftmaxParams,
 )
 from .attention import MultiHeadAttentionParams
+from .inc_attention import IncMultiHeadAttentionParams
 from .elementwise import ElementBinaryParams, ElementUnaryParams
 from .moe import (
     AggregateParams,

@@ -1,0 +1,155 @@
+"""Incremental (decode-phase) multi-head self-attention over a KV cache.
+
+The serving engine's core op (serving/): the reference snapshot predates
+FlexFlow's serving rewrite — this is its IncMultiHeadSelfAttention recast
+TPU-natively. Where training attention (ops/attention.py) recomputes K/V
+for the whole sequence every step, the decode op threads a **first-class
+stateful parallel tensor** per layer: `cache_k`/`cache_v`, shape
+(slots, max_seq_len + 1, embed_dim), declared as non-trainable weight
+specs so the executor places them by the searched plan exactly like any
+parameter — the slot dim rides the `data` axis with the batch, and a
+head-parallel plan shards the feature dim over `model`, splitting each
+chip's cache down to its own heads (the KV-cache placement Unity prices).
+
+One forward call processes q_len tokens per slot at arbitrary,
+per-element positions:
+
+  - **position-indexed KV write**: the new K/V rows scatter into the cache
+    at `positions` (a (slots, q_len) int32 input). Row `max_seq_len` is a
+    scratch row — elements whose position is clipped there (empty slots,
+    prefill padding) leave every real cache row untouched, which is how
+    the continuous-batching engine runs a fixed-shape executable while
+    slots sit at different sequence positions.
+  - **masked read**: query row i of slot s attends cache rows
+    [0, positions[s, i]] — intra-chunk causality during prefill falls out
+    of the per-row positions; q_len=1 is the decode iteration.
+
+Weight names match OP_MULTIHEAD_ATTENTION's (wq/wk/wv/wo + biases), so a
+trained model's parameters transfer to its decode graph by name. On TPU
+the q_len=1 path routes through the Pallas decode kernel
+(kernels/flash_attention.flash_decode_attention); CPU meshes use the
+reference einsum so tier-1 exercises serving end-to-end.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..fftype import DataType, OperatorType as OT
+from .base import OpDef, WeightSpec, matmul_cast, register_op
+
+
+@dataclass(frozen=True)
+class IncMultiHeadAttentionParams:
+    embed_dim: int
+    num_heads: int
+    max_seq_len: int  # real cache rows; row max_seq_len is the scratch row
+    use_bias: bool = True
+    impl: str = "auto"  # auto: flash decode on TPU (q_len=1), einsum else
+
+
+def _inc_mha_infer(p: IncMultiHeadAttentionParams, in_shapes):
+    x, positions = in_shapes
+    return [(x[0], x[1], p.embed_dim)]
+
+
+def _inc_mha_weights(p: IncMultiHeadAttentionParams, in_shapes):
+    x = in_shapes[0]
+    slots = x[0]
+    ws = [
+        WeightSpec("wq", (x[-1], p.embed_dim), DataType.DT_FLOAT),
+        WeightSpec("wk", (x[-1], p.embed_dim), DataType.DT_FLOAT),
+        WeightSpec("wv", (x[-1], p.embed_dim), DataType.DT_FLOAT),
+        WeightSpec("wo", (p.embed_dim, p.embed_dim), DataType.DT_FLOAT),
+    ]
+    if p.use_bias:
+        ws += [
+            WeightSpec("bq", (p.embed_dim,), DataType.DT_FLOAT, "zeros"),
+            WeightSpec("bk", (p.embed_dim,), DataType.DT_FLOAT, "zeros"),
+            WeightSpec("bv", (p.embed_dim,), DataType.DT_FLOAT, "zeros"),
+            WeightSpec("bo", (p.embed_dim,), DataType.DT_FLOAT, "zeros"),
+        ]
+    # the KV cache: stateful (non-trainable), zero-initialized, threaded
+    # functionally through the executor's state dict like BatchNorm stats
+    ws += [
+        WeightSpec("cache_k", (slots, p.max_seq_len + 1, p.embed_dim),
+                   DataType.DT_FLOAT, "zeros", trainable=False),
+        WeightSpec("cache_v", (slots, p.max_seq_len + 1, p.embed_dim),
+                   DataType.DT_FLOAT, "zeros", trainable=False),
+    ]
+    return ws
+
+
+def _inc_mha_forward(p: IncMultiHeadAttentionParams, inputs, weights,
+                     state, ctx):
+    x, positions = inputs
+    slots, q_len, _ = x.shape
+    H, E = p.num_heads, p.embed_dim
+    hd = E // H
+
+    def proj(t, w, b):
+        tm, wm = matmul_cast(ctx, t, w.astype(t.dtype))
+        y = jnp.dot(tm, wm, preferred_element_type=jnp.float32).astype(t.dtype)
+        if b is not None:
+            y = y + b.astype(y.dtype)
+        return y
+
+    q = proj(x, weights["wq"], weights.get("bq"))
+    k = proj(x, weights["wk"], weights.get("bk"))
+    v = proj(x, weights["wv"], weights.get("bv"))
+    scale = 1.0 / math.sqrt(hd)
+
+    ck, cv = weights["cache_k"], weights["cache_v"]
+    positions = positions.astype(jnp.int32)
+    # position-indexed write; >= max_seq_len clips to the scratch row, so
+    # padded/empty elements never disturb live cache state
+    write_pos = jnp.clip(positions, 0, p.max_seq_len)
+    slot_idx = jnp.arange(slots, dtype=jnp.int32)[:, None]
+    # scratch-bound elements write ZEROS, not their (garbage) K/V: a pad
+    # element's hidden state can be NaN (OOB position-embedding gather
+    # fills NaN), and although every read of the scratch row is masked,
+    # softmax zeros times a NaN V row would still poison the live rows'
+    # contraction — the cache must only ever hold finite values
+    live = (positions >= 0) & (positions < p.max_seq_len)
+    kw = jnp.where(live[..., None], k, 0.0)
+    vw = jnp.where(live[..., None], v, 0.0)
+    ck = ck.at[slot_idx, write_pos].set(kw.astype(ck.dtype))
+    cv = cv.at[slot_idx, write_pos].set(vw.astype(cv.dtype))
+
+    use_flash = (p.impl == "flash"
+                 or (p.impl == "auto" and jax.default_backend() == "tpu"))
+    if use_flash and q_len == 1:
+        from ..kernels.flash_attention import flash_decode_attention
+
+        out = flash_decode_attention(
+            q, ck.astype(q.dtype), cv.astype(q.dtype),
+            write_pos[:, 0] + 1, num_heads=H, scale=scale)
+    else:
+        from ..kernels.flash_attention import decode_attention_reference
+
+        out = decode_attention_reference(
+            q, ck.astype(q.dtype), cv.astype(q.dtype), write_pos,
+            num_heads=H, scale=scale)
+    y = proj(out, weights["wo"], weights.get("bo"))
+    return [y], {"cache_k": ck, "cache_v": cv}
+
+
+def _inc_mha_flops(p: IncMultiHeadAttentionParams, in_shapes, out_shapes):
+    x = in_shapes[0]
+    slots, q_len = x[0], x[1]
+    E = p.embed_dim
+    # four projections of the q_len new tokens + attention of each query
+    # against the full cache (the serving cost model prices the worst-case
+    # full-cache read; the kernel skips dead blocks at run time)
+    proj = 2.0 * slots * q_len * (3 * x[-1] * E + E * E)
+    attn = 2.0 * slots * p.num_heads * q_len * (p.max_seq_len + 1) * (
+        E // p.num_heads) * 2
+    return proj + attn
+
+
+register_op(OpDef(OT.OP_INC_MULTIHEAD_ATTENTION, _inc_mha_infer,
+                  _inc_mha_forward, _inc_mha_weights, _inc_mha_flops))

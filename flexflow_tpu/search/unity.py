@@ -110,6 +110,12 @@ class UnitySearch:
 
     # ---------------------------------------------------- candidate configs
 
+    def _batch_entry(self):
+        """The batch axes as one PartitionSpec entry (an axis name, or a
+        tuple when dcn composes with data)."""
+        return (self.batch_axes[0] if len(self.batch_axes) == 1
+                else tuple(self.batch_axes))
+
     def node_configs(self, node) -> list[NodeConfig]:
         """Candidate parallelizations (substitution families)."""
         pin = self.pinned.get(node.guid)
@@ -121,6 +127,16 @@ class UnitySearch:
                     and node.op_type != OT.OP_GROUP_BY)
         dp = NodeConfig("dp", _dp_assign(ndim, batch_ok,
                                           batch_axes=self.batch_axes))
+        if node.op_type == OT.OP_INC_MULTIHEAD_ATTENTION and batch_ok:
+            # cache-aware dp: the KV cache's slot dim rides the batch axes
+            # (matching model._assign_strategy's serving default), so the
+            # dp candidate is priced with the cache memory/IO per chip the
+            # executor will actually place — a replicated-cache price here
+            # would make dp look max_seq·slots-bytes heavier than it runs
+            dp = NodeConfig("dp", dp.out_assign, tuple(
+                (w.name, PartitionSpec(self._batch_entry(),
+                                       *([None] * (len(w.shape) - 1))))
+                for w in node.weight_specs if not w.trainable))
         out = [dp]
         if node.op_type == OT.OP_PIPE_BLOCKS:
             from ..machine import AXIS_PIPE
@@ -208,6 +224,32 @@ class UnitySearch:
                                          batch_axes=self.batch_axes))
                 assign[1] = (AXIS_SEQ,)
                 out.append(NodeConfig("sp", tuple(assign)))
+        elif node.op_type == OT.OP_INC_MULTIHEAD_ATTENTION:
+            p = node.params
+            if (allow_attr and p.num_heads % self.model_deg == 0
+                    and p.embed_dim % self.model_deg == 0):
+                # head-parallel decode attention: QKV column-parallel, O
+                # row-parallel (psum), and — the serving-specific dim —
+                # the KV cache's feature axis sharded over `model` so each
+                # chip stores and scans only its own heads' cache rows.
+                # The KV-cache placement is thereby a searched parallel
+                # dim priced by the same cost model as the projections.
+                ws = [(w, PartitionSpec(None, AXIS_MODEL))
+                      for w in ("wq", "wk", "wv")]
+                ws += [(b, PartitionSpec(AXIS_MODEL))
+                       for b in ("bq", "bk", "bv")]
+                ws += [("wo", PartitionSpec(AXIS_MODEL, None)),
+                       ("bo", PartitionSpec())]
+                ws += [(w.name, PartitionSpec(
+                            self._batch_entry() if batch_ok else None,
+                            None, AXIS_MODEL))
+                       for w in node.weight_specs if not w.trainable]
+                out.append(NodeConfig(
+                    "tp_attn",
+                    _dp_assign(ndim, batch_ok, batch_axes=self.batch_axes),
+                    tuple(ws),
+                    psum_axes=(AXIS_MODEL,),
+                ))
         elif node.op_type == OT.OP_CONV2D and allow_attr and ndim == 4:
             # channel/attribute-parallel conv (NCHW dim 1 over `model`,
             # OIHW kernel dim 0 sharded) — the conv sibling of tp_attn

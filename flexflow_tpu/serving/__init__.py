@@ -1,0 +1,23 @@
+"""Serving engine on the PCG (docs/serving.md).
+
+The inference half the reference snapshot predates: `model.serve()`
+compiles a *decode* graph from the same PCG the trainer built — causal
+attention becomes incremental attention over first-class sharded KV-cache
+state, placed and priced by the same Unity search and warm-started by the
+same plan cache — and runs Orca-style continuous batching over a fixed
+slot set with greedy/temperature sampling, EOS/max-length completion, and
+per-request time-to-first-token telemetry.
+
+    engine = model.serve(slots=8, max_new_tokens=64)
+    outputs = engine.generate(prompts)          # batch convenience
+    req = engine.submit(prompt); engine.step()  # iteration-level control
+"""
+
+from .decode_graph import ServingSpec, adopt_params, build_decode_model
+from .engine import ServingEngine
+from .scheduler import ContinuousBatchingScheduler, Request, Slot
+
+__all__ = [
+    "ServingEngine", "ServingSpec", "Request", "Slot",
+    "ContinuousBatchingScheduler", "build_decode_model", "adopt_params",
+]

@@ -1,0 +1,209 @@
+"""Decode-graph construction: the trainer's PCG, re-expressed for serving.
+
+The serving engine does NOT fork the model definition: it replays the
+trained FFModel's layer list into a fresh FFModel whose inputs are
+(slots, 1)-shaped — one new token per continuous-batching slot — and
+whose causal `multihead_attention` layers become `inc_multihead_attention`
+over per-layer KV-cache state (ops/inc_attention.py). Everything else
+(embeddings, norms, MLPs, residuals, tied weights) replays verbatim with
+the SAME layer names, so:
+
+  - the trained parameters transfer to the decode graph by (node, weight)
+    name — `adopt_params` re-places them under the decode plan's
+    shardings;
+  - the decode graph is a real PCG: `FFModel.compile` runs the same Unity
+    search (the KV-cache placement priced as a parallel dim,
+    search/unity.py), the same warm-start plan cache (a second serving
+    compile of the same (model, slots, max_seq, mesh) is a fingerprint
+    hit with zero evaluations), and the same telemetry/diagnostics hooks
+    as a training compile.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..fftype import CompMode, DataType, LossType, OperatorType as OT
+
+
+@dataclass
+class ServingSpec:
+    """Engine-level serving parameters (model.serve(**overrides))."""
+
+    slots: int = 4
+    max_seq_len: int = 0  # 0 → the model's training sequence length
+    prefill_chunk: int = 16
+    max_new_tokens: int = 32  # per-request default
+    eos_id: Optional[int] = None  # per-request default (None = never)
+    impl: str = "auto"  # decode attention impl (auto|xla|flash)
+    # extra FFConfig fields applied to the decode compile only (e.g.
+    # {"search_budget": 6, "enable_parameter_parallel": True})
+    config_overrides: dict = field(default_factory=dict)
+    # explicit decode-plan overrides (Strategy or raw dict) — applied via
+    # set_strategy, plan_source "manual"; None → search/cache/default
+    strategy: object = None
+
+
+def _decode_config(model, spec: ServingSpec):
+    """The decode compile's FFConfig: the trainer's, minus run-lifecycle
+    subsystems that belong to the training job (its checkpoints, its
+    telemetry session), plus spec.config_overrides. Search flags, mesh
+    axes, and the warm-start dir carry over — the decode plan is searched
+    and cached with the same machinery."""
+    cfg = copy.copy(model.config)  # plain copy: __post_init__ re-parses argv
+    cfg.batch_size = spec.slots
+    cfg.telemetry_dir = ""
+    cfg.xprof_dir = ""
+    cfg.diagnostics = False
+    cfg.checkpoint_dir = ""
+    cfg.auto_resume = False
+    cfg.pipeline_steps = 1
+    cfg.import_strategy_file = ""
+    cfg.export_strategy_file = ""
+    cfg.export_strategy_computation_graph_file = ""
+    for k, v in (spec.config_overrides or {}).items():
+        if not hasattr(cfg, k):
+            raise ValueError(f"config_overrides: FFConfig has no field {k!r}")
+        setattr(cfg, k, v)
+    return cfg
+
+
+def infer_max_seq_len(model) -> int:
+    """Default KV-cache length: the training graph's sequence extent (dim 1
+    of the first embedding-consuming input), so decode never outruns the
+    learned positional table."""
+    for t in model._input_tensors:
+        if len(t.dims) >= 2:
+            return int(t.dims[1])
+    raise ValueError("cannot infer max_seq_len: no rank-2 input "
+                     "(pass max_seq_len explicitly)")
+
+
+def build_decode_model(model, spec: ServingSpec):
+    """Replay `model`'s layers into a compiled decode FFModel.
+
+    Raises for graphs serving can't express yet: non-causal or
+    cross-attention (decode needs self-attention with a causal order), and
+    ops whose shape inference rejects (slots, 1, ...) activations."""
+    from ..model import FFModel
+    from ..optimizer import SGDOptimizer
+
+    max_seq = spec.max_seq_len or infer_max_seq_len(model)
+    dec = FFModel(_decode_config(model, spec))
+
+    # --- inputs: (batch, seq, ...) → (slots, 1, ...); the `positions`
+    # input doubles as every attention layer's position feed
+    tensor_map: dict[int, object] = {}
+    positions = None
+    for t in model._input_tensors:
+        if len(t.dims) < 2:
+            raise ValueError(
+                f"serving input {t.name!r} is rank {len(t.dims)}; decode "
+                f"inputs need a (batch, seq, ...) shape")
+        nt = dec.create_tensor((spec.slots, 1) + tuple(t.dims[2:]),
+                               t.dtype, create_grad=False, name=t.name)
+        if hasattr(t, "constant_value"):
+            nt.constant_value = t.constant_value
+        tensor_map[t.tensor_guid] = nt
+        if t.name == "positions":
+            positions = nt
+    if positions is None:
+        positions = dec.create_tensor((spec.slots, 1), DataType.DT_INT32,
+                                      create_grad=False, name="positions")
+
+    # --- layers, replayed name-for-name
+    layer_map: dict[int, object] = {}  # train layer guid -> decode Layer
+    for layer in model.layers:
+        ins = []
+        for t in layer.inputs:
+            mapped = tensor_map.get(t.tensor_guid)
+            if mapped is None:
+                raise ValueError(
+                    f"layer {layer.name!r} reads a tensor serving did not "
+                    f"replay ({t.name!r})")
+            ins.append(mapped)
+        shared = None
+        if layer.shared_layer_guid >= 0:
+            src = layer_map.get(layer.shared_layer_guid)
+            if src is None:
+                raise ValueError(
+                    f"{layer.name}: tied-weight source layer not replayed")
+            shared = src
+        if layer.op_type == OT.OP_MULTIHEAD_ATTENTION:
+            p = layer.params
+            if not p.causal:
+                raise ValueError(
+                    f"{layer.name}: serving decode requires causal "
+                    f"attention (non-causal layers see future tokens the "
+                    f"cache does not hold yet)")
+            if not (layer.inputs[0] is layer.inputs[1]
+                    is layer.inputs[2]):
+                raise ValueError(
+                    f"{layer.name}: serving decode supports "
+                    f"self-attention only (q, k, v must be one tensor)")
+            if (p.kdim not in (0, p.embed_dim)
+                    or p.vdim not in (0, p.embed_dim)):
+                raise ValueError(
+                    f"{layer.name}: kdim/vdim != embed_dim not supported "
+                    f"in the decode graph")
+            from ..ops import IncMultiHeadAttentionParams
+
+            np_ = IncMultiHeadAttentionParams(
+                p.embed_dim, p.num_heads, max_seq, p.use_bias,
+                impl=spec.impl)
+            new = dec._add_layer(
+                OT.OP_INC_MULTIHEAD_ATTENTION, np_, [ins[0], positions],
+                name=layer.name, data_type=layer.data_type)
+        else:
+            new = dec._add_layer(
+                layer.op_type, layer.params, ins, name=layer.name,
+                initializers=dict(layer.initializers),
+                data_type=layer.data_type, shared_op=shared)
+        layer_map[layer.layer_guid] = new
+        for t_out, d_out in zip(layer.outputs, new.outputs):
+            tensor_map[t_out.tensor_guid] = d_out
+
+    if spec.strategy is not None:
+        dec.set_strategy(spec.strategy)
+    dec.compile(optimizer=SGDOptimizer(lr=0.0),
+                loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                comp_mode=CompMode.COMP_MODE_INFERENCE)
+    return dec, max_seq
+
+
+def adopt_params(dec, model) -> int:
+    """Move the trained model's parameters into the decode model by
+    (node, weight) name, re-placed under the decode plan's shardings
+    (set_weight device_puts with the decode-side sharding). Non-trainable
+    state with a matching name/shape (e.g. BatchNorm stats) transfers
+    too; the KV caches keep their zero init. Returns weights adopted."""
+    import numpy as np
+
+    moved = 0
+    for node_name, ws in dec._params.items():
+        for wname in ws:
+            val = model.get_weight(node_name, wname)
+            if tuple(val.shape) != tuple(np.asarray(ws[wname]).shape):
+                raise ValueError(
+                    f"{node_name}.{wname}: trained shape {val.shape} != "
+                    f"decode shape {np.asarray(ws[wname]).shape}")
+            dec.set_weight(node_name, wname, val)
+            moved += 1
+    for node_name, ws in (dec._state or {}).items():
+        src = (model._state or {}).get(
+            model._resolve_weight_owner(node_name), {})
+        for wname in ws:
+            if wname in ("cache_k", "cache_v"):
+                continue
+            if wname in src:
+                arr = np.asarray(src[wname])
+                old = ws[wname]
+                import jax
+                import jax.numpy as jnp
+
+                ws[wname] = jax.device_put(
+                    jnp.asarray(arr, old.dtype), old.sharding)
+                moved += 1
+    return moved

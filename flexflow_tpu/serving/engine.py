@@ -1,0 +1,348 @@
+"""ServingEngine: continuous-batching inference on the decode PCG.
+
+The device side of serving (scheduler.py is the policy side): one donated
+jitted step (`Executor.build_decode_step`) threads (params, kv-cache
+state, tokens, positions) and returns the next token per slot, sampled
+in-program (greedy / temperature-Gumbel per slot). Prefill reuses the
+pipelined engine's chunk planner (engine/chunking.plan_chunks) to walk a
+prompt through the SAME step in power-of-two length buckets — each bucket
+one cached executable — writing the prompt's K/V rows into the admitted
+slot's cache while every other slot's writes land on the scratch row
+(position redirection, ops/inc_attention.py), so a fixed-shape executable
+serves slots at arbitrary, different sequence positions.
+
+Invariants the tests pin down (tests/test_serving.py):
+  - greedy decode is token-identical to the teacher-forced training
+    forward's argmax at every position;
+  - an interleaved continuous batch is token-identical to serving each
+    request alone (slot rows are computed independently);
+  - the engine compile is a normal Unity compile: warm-start plan-cache
+    hits apply (second serving compile of the same (model, slots,
+    max_seq, mesh) = 0 search evaluations).
+
+Telemetry (when the trained model has a session): `serve.compile` /
+`serve.prefill` / `serve.step` spans, per-iteration queue-depth and
+slot-occupancy counters, a `serve.request` event per completion carrying
+time-to-first-token, and a `serve.summary` event with requests/s/chip and
+decode tokens/s/chip.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import telemetry
+from ..engine.chunking import plan_chunks
+from .decode_graph import ServingSpec, adopt_params, build_decode_model
+from .scheduler import ContinuousBatchingScheduler, Request
+
+
+class ServingEngine:
+    def __init__(self, model, **overrides):
+        import jax
+
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "serving runs single-controller for now (multi-host "
+                "serving is the prefill/decode disaggregation item, "
+                "ROADMAP)")
+        cfg = model.config
+        spec = ServingSpec(
+            slots=cfg.serve_slots,
+            max_seq_len=cfg.serve_max_seq_len,
+            prefill_chunk=cfg.serve_prefill_chunk,
+        )
+        for k, v in overrides.items():
+            if not hasattr(spec, k):
+                raise ValueError(f"serve(): unknown option {k!r}")
+            setattr(spec, k, v)
+        if spec.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.model = model
+        self.spec = spec
+        self.telemetry = model._telemetry
+        with self._active():
+            t0 = time.perf_counter()
+            with telemetry.span("serve.compile", slots=spec.slots):
+                self.decode_model, self.max_seq_len = build_decode_model(
+                    model, spec)
+                self.adopted = adopt_params(self.decode_model, model)
+                self._step_fn = (
+                    self.decode_model.executor.build_decode_step())
+            telemetry.event(
+                "serve.compile",
+                duration_s=time.perf_counter() - t0,
+                slots=spec.slots, max_seq_len=self.max_seq_len,
+                prefill_chunk=spec.prefill_chunk,
+                plan_source=self.decode_model._plan_source,
+                weights_adopted=self.adopted,
+                mesh_axes={k: int(v) for k, v
+                           in self.decode_model.mesh.shape.items()})
+            if self.telemetry is not None:
+                self.telemetry.flush()
+        self.scheduler = ContinuousBatchingScheduler(
+            spec.slots, self.max_seq_len)
+        self.num_chips = int(self.decode_model.mesh.devices.size)
+        self._rng = None  # lazily split jax PRNG for sampling steps
+        # graph input roles: exactly one token stream + the positions feed
+        # (+ constants, which the engine materializes itself)
+        self._token_input = None
+        self._const_inputs = {}
+        for t in self.decode_model._input_tensors:
+            if t.name == "positions":
+                continue
+            if hasattr(t, "constant_value"):
+                self._const_inputs[t.name] = (
+                    tuple(t.dims), t.dtype, t.constant_value)
+            elif self._token_input is None:
+                self._token_input = t.name
+            else:
+                raise ValueError(
+                    f"serving needs exactly one token input; model has "
+                    f"{self._token_input!r} and {t.name!r}")
+        if self._token_input is None:
+            raise ValueError("serving: model has no token input")
+        # run accounting (stats())
+        self._decode_iterations = 0
+        self._decode_tokens = 0
+        self._prefill_tokens = 0
+        self._prefill_calls = 0
+        self._device_s = 0.0
+
+    # ------------------------------------------------------------ session
+
+    @contextlib.contextmanager
+    def _active(self):
+        """Route module-level telemetry to the trained model's session for
+        the duration of one engine operation. No flush here — step() runs
+        once per generated token, and a per-iteration flush would rewrite
+        the whole trace buffer each time (quadratic I/O in the hot loop);
+        the trace persists at compile end, drain end, and session close."""
+        tel = self.telemetry
+        if tel is None:
+            yield
+            return
+        telemetry.activate(tel)
+        try:
+            yield
+        finally:
+            telemetry.deactivate(tel)
+
+    # ------------------------------------------------------------ intake
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: Optional[int] = None,
+               temperature: Optional[float] = None,
+               eos_id: Optional[int] = None) -> Request:
+        """Queue one request (FCFS). Defaults come from the ServingSpec."""
+        req = Request(
+            prompt=[int(t) for t in prompt],
+            max_new_tokens=(self.spec.max_new_tokens
+                            if max_new_tokens is None else max_new_tokens),
+            temperature=0.0 if temperature is None else float(temperature),
+            eos_id=self.spec.eos_id if eos_id is None else eos_id,
+        )
+        return self.scheduler.submit(req)
+
+    # ------------------------------------------------------------ device step
+
+    def _bucket(self, n: int) -> int:
+        """Smallest power-of-two >= n, capped at prefill_chunk (which is
+        itself the top bucket when it isn't a power of two) — the
+        length-bucket set, so prompt raggedness costs O(log chunk)
+        executables instead of one per distinct length."""
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.spec.prefill_chunk)
+
+    def _run_step(self, tokens: np.ndarray, positions: np.ndarray,
+                  read_idx: np.ndarray) -> np.ndarray:
+        """One decode-graph call: stage inputs with their searched
+        shardings, run the donated step, return the sampled tokens."""
+        import jax
+        import jax.numpy as jnp
+
+        dec = self.decode_model
+        q = tokens.shape[1]
+        xs = {self._token_input: tokens, "positions": positions}
+        for name, (dims, dtype, value) in self._const_inputs.items():
+            from ..fftype import dtype_to_jnp
+
+            xs[name] = np.full((dims[0], q) + tuple(dims[2:]), value,
+                               dtype_to_jnp(dtype))
+        specs = {}
+        for name in xs:
+            spec = dec._input_partition_spec(name)
+            if spec is not None:
+                specs[name] = spec
+        xs = dec.executor.shard_batch(xs, specs)
+        if self._rng is None:
+            self._rng = jax.random.key(dec.config.seed)
+        self._rng, sub = jax.random.split(self._rng)
+        temp = np.zeros((self.spec.slots,), np.float32)
+        for s in self.scheduler.active_slots:
+            temp[s.index] = s.request.temperature
+        t0 = time.perf_counter()
+        dec._state, next_tok = self._step_fn(
+            dec._params, dec._state, xs,
+            jnp.asarray(read_idx, jnp.int32), sub,
+            jnp.asarray(temp))
+        out = np.asarray(jax.device_get(next_tok))
+        self._device_s += time.perf_counter() - t0
+        return out
+
+    # ------------------------------------------------------------ prefill
+
+    def _prefill(self, slot, req: Request):
+        """Walk the prompt through the decode step in bucketed chunks,
+        filling `slot`'s cache rows; the final chunk's last live logits
+        row samples the request's first token (TTFT lands here)."""
+        prompt = req.prompt
+        L = len(prompt)
+        chunks = plan_chunks(0, L, self.spec.prefill_chunk)
+        with telemetry.span("serve.prefill", slot=slot.index,
+                            prompt_tokens=L, chunks=len(chunks)):
+            for start, n in chunks:
+                b = self._bucket(n)
+                tokens = np.zeros((self.spec.slots, b), np.int32)
+                # scratch-row positions everywhere but the admitted slot's
+                # live elements: no other slot's cache state moves
+                positions = np.full((self.spec.slots, b), self.max_seq_len,
+                                    np.int32)
+                read_idx = np.zeros((self.spec.slots,), np.int32)
+                tokens[slot.index, :n] = prompt[start:start + n]
+                positions[slot.index, :n] = np.arange(
+                    start, start + n, dtype=np.int32)
+                read_idx[slot.index] = n - 1
+                next_tok = self._run_step(tokens, positions, read_idx)
+        self._prefill_tokens += L
+        self._prefill_calls += len(chunks)
+        slot.length = L
+        first = int(next_tok[slot.index])
+        self._decode_tokens += 1
+        if not self.scheduler.note_token(slot, first):
+            return
+        self._note_completion(slot, req)
+
+    def _note_completion(self, slot, req: Request):
+        telemetry.instant("serve.done", request=req.request_id,
+                          reason=req.finish_reason)
+        telemetry.event(
+            "serve.request", request_id=req.request_id,
+            prompt_tokens=len(req.prompt), new_tokens=len(req.generated),
+            finish_reason=req.finish_reason,
+            ttft_s=req.ttft_s,
+            total_s=(req.finish_t - req.submit_t
+                     if req.finish_t is not None else None))
+
+    # ------------------------------------------------------------ iterate
+
+    def step(self) -> list[Request]:
+        """ONE scheduler iteration (the Orca unit): admit pending requests
+        into free slots (prefilling each), then run one decode step for
+        every active slot. Returns the requests that completed during this
+        iteration."""
+        sched = self.scheduler
+        done_before = len(sched.completed)
+        with self._active():
+            for slot, req in sched.admissions():
+                self._prefill(slot, req)
+            active = sched.active_slots
+            telemetry.counter("serve.slots", {
+                "active": len(active), "queue": sched.queue_depth,
+                "occupancy": len(active) / max(1, len(sched.slots))})
+            if active:
+                tokens = np.zeros((self.spec.slots, 1), np.int32)
+                positions = np.full((self.spec.slots, 1), self.max_seq_len,
+                                    np.int32)
+                read_idx = np.zeros((self.spec.slots,), np.int32)
+                for s in active:
+                    tokens[s.index, 0] = s.last_token
+                    positions[s.index, 0] = s.length
+                with telemetry.span("serve.step", active=len(active)):
+                    next_tok = self._run_step(tokens, positions, read_idx)
+                self._decode_iterations += 1
+                for s in active:
+                    s.length += 1
+                    req = s.request
+                    self._decode_tokens += 1
+                    if self.scheduler.note_token(s, int(next_tok[s.index])):
+                        self._note_completion(s, req)
+        return sched.completed[done_before:]
+
+    def run_until_drained(self, max_iterations: int = 0) -> list[Request]:
+        """Iterate until queue and slots are empty; returns every request
+        completed during the call. `max_iterations` > 0 bounds the loop
+        (a safety valve for drivers)."""
+        done: list[Request] = []
+        t0 = time.perf_counter()
+        it = 0
+        while not self.scheduler.drained:
+            done.extend(self.step())
+            it += 1
+            if max_iterations and it >= max_iterations:
+                break
+        self._last_wall_s = time.perf_counter() - t0
+        with self._active():
+            telemetry.event("serve.summary", **self.stats())
+        if self.telemetry is not None:
+            self.telemetry.flush()
+        return done
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 **request_kw) -> list[list[int]]:
+        """Convenience batch API: submit every prompt, drain, return the
+        generated token lists in submission order."""
+        reqs = [self.submit(p, **request_kw) for p in prompts]
+        self.run_until_drained()
+        return [r.generated for r in reqs]
+
+    # ------------------------------------------------------------ stats
+
+    def reset_stats(self) -> None:
+        """Zero the run accounting (and the completed-request list) —
+        benchmark drivers call this after a warm-up drain so the measured
+        window starts clean. Live slots/queue state is untouched."""
+        self.scheduler.completed.clear()
+        self._decode_iterations = 0
+        self._decode_tokens = 0
+        self._prefill_tokens = 0
+        self._prefill_calls = 0
+        self._device_s = 0.0
+        self._last_wall_s = 0.0
+
+    def stats(self) -> dict:
+        """Aggregate run metrics; rates are per chip of the decode mesh
+        over the last drain's WALL-clock window — scheduler and telemetry
+        overhead included, since that is the throughput a client sees
+        (`device_s` reports the device-busy slice separately;
+        requests/s/chip is the ROADMAP's serving bench target)."""
+        completed = self.scheduler.completed
+        wall = getattr(self, "_last_wall_s", 0.0) or 0.0
+        ttfts = [r.ttft_s for r in completed if r.ttft_s is not None]
+        out = {
+            "slots": self.spec.slots,
+            "max_seq_len": self.max_seq_len,
+            "num_chips": self.num_chips,
+            "requests_completed": len(completed),
+            "decode_iterations": self._decode_iterations,
+            "decode_tokens": self._decode_tokens,
+            "prefill_tokens": self._prefill_tokens,
+            "prefill_calls": self._prefill_calls,
+            "wall_s": wall,
+            "device_s": self._device_s,
+            "plan_source": self.decode_model._plan_source,
+        }
+        if ttfts:
+            out["ttft_p50_s"] = float(np.percentile(np.asarray(ttfts), 50))
+            out["ttft_max_s"] = float(max(ttfts))
+        if wall > 0:
+            out["requests_per_sec_per_chip"] = (
+                len(completed) / wall / self.num_chips)
+            out["decode_tokens_per_sec_per_chip"] = (
+                self._decode_tokens / wall / self.num_chips)
+        return out

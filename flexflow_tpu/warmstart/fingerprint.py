@@ -46,6 +46,11 @@ _SEARCH_CONFIG_FIELDS = (
     "num_nodes", "workers_per_node",
     "computation_dtype", "allow_tensor_op_math_conversion",
     "force_tensor_op_math",
+    # serving (serving/): a decode graph compiles under
+    # COMP_MODE_INFERENCE — its plans must never share an address with a
+    # training compile's (the graphs differ structurally too, but the
+    # mode is the cheap, explicit discriminator)
+    "computation_mode",
 )
 
 

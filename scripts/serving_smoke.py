@@ -1,0 +1,153 @@
+"""Serving smoke: serve synthetic requests on the CPU mesh, validate
+artifacts — the CI gate for the serving subsystem (docs/serving.md).
+
+Runs a small Transformer LM, builds the serving engine TWICE, and asserts
+
+  - every request completes, with tokens and a finish reason;
+  - greedy decode is token-identical between the two engines;
+  - telemetry carries the serving surface: serve.compile (plan_source),
+    one serve.request per completion (TTFT > 0), a serve.summary with
+    requests/s/chip + decode tokens/s/chip, and the serve.prefill /
+    serve.step trace spans;
+  - with --warmstart-dir, the SECOND engine's compile is a plan-cache hit
+    (plan_source == "cache") — the serving acceptance criterion.
+
+Usage:
+  python scripts/serving_smoke.py --telemetry-dir OUT \
+      [--warmstart-dir WS --mesh 2,4,1,1 --budget 4 \
+       --enable-parameter-parallel] [flexflow flags]
+Exits nonzero with a diagnostic on any missing artifact/field.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# virtual 8-device CPU mesh, exactly like tests/conftest.py
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+NUM_REQUESTS = 6
+
+
+def fail(msg: str):
+    print(f"serving_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerLMConfig, build_transformer_lm
+    from flexflow_tpu.telemetry import read_jsonl
+
+    config = FFConfig()  # parses --telemetry-dir/--warmstart-dir/... from argv
+    if not config.telemetry_dir:
+        fail("pass --telemetry-dir")
+    lm = TransformerLMConfig(vocab_size=128, hidden_size=32, num_heads=4,
+                             num_layers=2, sequence_length=32,
+                             attention_impl="xla")
+    # the TRAIN compile stays data-parallel (fast); the search flags on
+    # argv apply to the DECODE compiles via config_overrides below
+    search_overrides = dict(
+        only_data_parallel=config.only_data_parallel,
+        search_budget=config.search_budget,
+        enable_parameter_parallel=config.enable_parameter_parallel,
+        enable_attribute_parallel=config.enable_attribute_parallel,
+        search_calibrate=config.search_calibrate,
+        warmstart_dir=config.warmstart_dir,
+    )
+    config.only_data_parallel = True
+    config.warmstart_dir = ""
+    config.batch_size = 8
+    ff = FFModel(config)
+    build_transformer_lm(ff, lm, batch_size=8)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(1, lm.vocab_size, rs.randint(2, 9)).tolist()
+               for _ in range(NUM_REQUESTS)]
+    serve_kw = dict(slots=4, max_new_tokens=8, prefill_chunk=4,
+                    config_overrides=search_overrides)
+
+    engine = ff.serve(**serve_kw)
+    outputs = engine.generate(prompts)
+    stats = engine.stats()
+    if stats["requests_completed"] != NUM_REQUESTS:
+        fail(f"completed {stats['requests_completed']}/{NUM_REQUESTS}")
+    for i, (req, out) in enumerate(zip(engine.scheduler.completed, outputs)):
+        if not out:
+            fail(f"request {i} produced no tokens")
+        if req.finish_reason not in ("max_tokens", "eos", "length"):
+            fail(f"request {i} has no finish reason")
+
+    # second engine: token identity always; plan-cache hit with a
+    # populated --warmstart-dir
+    engine2 = ff.serve(**serve_kw)
+    if engine2.generate(prompts) != outputs:
+        fail("second engine's greedy output differs (determinism broken)")
+    if search_overrides["warmstart_dir"]:
+        if engine.decode_model._plan_source != "search":
+            fail(f"first serving compile expected plan_source=search, got "
+                 f"{engine.decode_model._plan_source!r}")
+        if engine2.decode_model._plan_source != "cache":
+            fail(f"second serving compile expected plan_source=cache, got "
+                 f"{engine2.decode_model._plan_source!r} (warm-start plan "
+                 f"cache missed)")
+    ff.get_telemetry().close()
+
+    # ---- artifact validation
+    tdir = config.telemetry_dir
+    recs = read_jsonl(os.path.join(tdir, "metrics.jsonl"))
+    compiles = [r for r in recs if r["kind"] == "serve.compile"]
+    if len(compiles) != 2:
+        fail(f"expected 2 serve.compile records, got {len(compiles)}")
+    for c in compiles:
+        for field in ("plan_source", "slots", "max_seq_len", "duration_s"):
+            if field not in c:
+                fail(f"serve.compile missing {field}: {c}")
+    reqs = [r for r in recs if r["kind"] == "serve.request"]
+    if len(reqs) != 2 * NUM_REQUESTS:
+        fail(f"expected {2 * NUM_REQUESTS} serve.request records, "
+             f"got {len(reqs)}")
+    for r in reqs:
+        if not (r.get("ttft_s") or 0) > 0:
+            fail(f"serve.request without ttft_s: {r}")
+        if "finish_reason" not in r or "new_tokens" not in r:
+            fail(f"malformed serve.request: {r}")
+    summaries = [r for r in recs if r["kind"] == "serve.summary"]
+    if len(summaries) < 2:
+        fail(f"expected >=2 serve.summary records, got {len(summaries)}")
+    for field in ("requests_per_sec_per_chip",
+                  "decode_tokens_per_sec_per_chip", "ttft_p50_s",
+                  "decode_iterations"):
+        if not (summaries[-1].get(field, 0) > 0):
+            fail(f"serve.summary field {field} missing/zero: "
+                 f"{summaries[-1]}")
+
+    with open(os.path.join(tdir, "trace.json")) as f:
+        names = {e["name"] for e in json.load(f)["traceEvents"]}
+    for span in ("serve.compile", "serve.prefill", "serve.step"):
+        if span not in names:
+            fail(f"trace missing span {span!r} (have {sorted(names)})")
+
+    summ = summaries[-1]
+    print(f"serving_smoke: OK — {NUM_REQUESTS} requests x2 engines, "
+          f"plan {compiles[0]['plan_source']}->{compiles[1]['plan_source']}, "
+          f"ttft_p50={summ['ttft_p50_s'] * 1e3:.1f}ms "
+          f"req/s/chip={summ['requests_per_sec_per_chip']:.2f} "
+          f"decode tok/s/chip={summ['decode_tokens_per_sec_per_chip']:.1f}")
+
+
+if __name__ == "__main__":
+    main()

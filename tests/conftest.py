@@ -66,6 +66,30 @@ def _probe_pallas_flash():
         return f"{type(e).__name__}: {e}"
 
 
+def _probe_pallas_decode():
+    """Run a tiny single-query decode-attention kernel call (interpret
+    mode on CPU) — the serving flash path (kernels/flash_attention.
+    flash_decode_attention). Returns None when supported, else the
+    failure reason."""
+    try:
+        import jax.numpy as jnp
+
+        from flexflow_tpu.kernels.flash_attention import (
+            flash_decode_attention,
+        )
+
+        # cache >= 128 rows so the probe clears the einsum-fallback gate
+        # and exercises the real Pallas decode kernel
+        q = jnp.zeros((1, 1, 32), jnp.float32)
+        kv = jnp.zeros((1, 128, 32), jnp.float32)
+        jax.block_until_ready(flash_decode_attention(
+            q, kv, kv, jnp.ones((1,), jnp.int32), num_heads=1,
+            interpret=True))
+        return None
+    except Exception as e:  # noqa: BLE001 - any env failure is the answer
+        return f"{type(e).__name__}: {e}"
+
+
 def _probe_shard_map():
     """The parallel/ modules (ring attention, pipeline) use jax.shard_map,
     which older jax only ships as jax.experimental.shard_map."""
@@ -85,6 +109,8 @@ def _probe_shard_map():
 _CAPABILITIES = [
     ("pallas/flash-attention", re.compile(r"pallas|Pallas|CompilerParams"),
      _probe_pallas_flash),
+    ("pallas/flash-decode", re.compile(r"pallas|Pallas|CompilerParams"),
+     _probe_pallas_decode),
     ("shard_map", re.compile(r"shard_map"), _probe_shard_map),
 ]
 _probe_results: dict = {}

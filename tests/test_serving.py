@@ -1,0 +1,331 @@
+"""Serving-engine tests (serving/, docs/serving.md).
+
+The acceptance surface of the decode-graph + continuous-batching
+subsystem, on the CPU mesh (the decode attention op routes through the
+reference einsum there, so everything below is Pallas-free except the
+kernel-parity test, which the conftest capability probe converts to a
+clean skip on environment gaps):
+
+  - greedy decode is token-identical to the teacher-forced training
+    forward's argmax at every generated position;
+  - an interleaved continuous batch (requests admitted/evicted mid-run)
+    is token-identical to serving each request alone;
+  - the KV cache round-trips a tensor-parallel mesh: a head-parallel plan
+    shards the cache feature dim over `model` and decode stays
+    token-identical to the single-device engine;
+  - EOS / max_new_tokens / cache-capacity completion all fire with the
+    right reasons;
+  - a second serving compile of the same (model, slots, max_seq, mesh)
+    against one --warmstart-dir is a plan-cache hit: ZERO
+    UnitySearch.evaluate calls, zero joint_graph_optimize calls.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+
+def _lm_config():
+    from flexflow_tpu.models import TransformerLMConfig
+
+    return TransformerLMConfig(
+        vocab_size=64, hidden_size=32, num_heads=4, num_layers=2,
+        sequence_length=32, attention_impl="xla")
+
+
+def _build_lm(mesh=(1, 1, 1, 1), batch=8, argv=()):
+    sys.argv = ["test"] + list(argv)
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import build_transformer_lm
+
+    cfg = FFConfig()
+    if cfg.mesh_axis_sizes is None:
+        cfg.mesh_axis_sizes = mesh
+    cfg.batch_size = batch
+    ff = FFModel(cfg)
+    build_transformer_lm(ff, _lm_config(), batch_size=batch)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def _teacher_argmax(ff, sequence):
+    """Training-graph forward over `sequence`; argmax at every position."""
+    import jax
+
+    T = len(sequence)
+    toks = np.asarray(sequence, np.int32)[None, :]
+    pos = np.arange(T, dtype=np.int32)[None, :]
+    fwd = ff.executor._forward_fn or ff.executor.build_forward()
+    xs = ff.executor.shard_batch({"tokens": toks, "positions": pos}, {})
+    logits, _ = fwd(ff._params, ff._state, xs, False)
+    return np.asarray(jax.device_get(logits)).argmax(-1)[0]
+
+
+PROMPTS = [[3, 7, 11, 2, 5], [5, 2], [1, 9, 30, 30, 12, 4, 8], [60, 1, 2]]
+
+
+def test_greedy_decode_parity_vs_teacher_forced():
+    """Every greedy-decoded token equals the training forward's argmax at
+    that position, for prompts long and short of the prefill chunk (so
+    both the bucketed prefill and the q=1 decode path are checked)."""
+    ff = _build_lm(batch=1)
+    eng = ff.serve(slots=2, max_new_tokens=8, prefill_chunk=4)
+    for prompt in PROMPTS:
+        (gen,) = eng.generate([prompt])
+        assert len(gen) == 8
+        seq = prompt + gen
+        am = _teacher_argmax(ff, seq)
+        want = am[len(prompt) - 1 : len(seq) - 1].tolist()
+        assert gen == want, f"prompt {prompt}: decode {gen} != teacher {want}"
+
+
+def test_continuous_batching_invariance():
+    """Interleaved batch == sequential single-request runs, token for
+    token. Five requests through two slots forces mid-run admission and
+    slot reuse (stale cache rows from the previous resident must never
+    leak into the next request)."""
+    ff = _build_lm(batch=1)
+    prompts = PROMPTS + [[2, 4, 6, 8]]
+
+    eng = ff.serve(slots=2, max_new_tokens=6, prefill_chunk=4)
+    interleaved = eng.generate(prompts)
+    assert eng.scheduler.drained
+    # two slots, five requests: admissions happened while others decoded
+    assert eng.stats()["requests_completed"] == 5
+
+    solo_eng = ff.serve(slots=2, max_new_tokens=6, prefill_chunk=4)
+    solo = [solo_eng.generate([p])[0] for p in prompts]
+    assert interleaved == solo
+
+
+def test_kv_cache_sharding_roundtrip_tp_mesh():
+    """A head-parallel decode plan on a (data=2, model=2) mesh — QKV/O
+    sharded, KV cache feature dim over `model`, slot dim over `data` —
+    produces token-identical output to the single-device engine, and the
+    cache state actually carries the sharded spec."""
+    from jax.sharding import PartitionSpec as P
+
+    ff = _build_lm(mesh=(2, 2, 1, 1), batch=8)
+    strat = {}
+    for i in range(2):
+        strat[f"l{i}_attn"] = {"outputs": {}, "weights": {
+            "wq": P(None, "model"), "wk": P(None, "model"),
+            "wv": P(None, "model"),
+            "bq": P("model"), "bk": P("model"), "bv": P("model"),
+            "wo": P("model", None), "bo": P(),
+            "cache_k": P("data", None, "model"),
+            "cache_v": P("data", None, "model"),
+        }}
+    eng = ff.serve(slots=4, max_new_tokens=5, prefill_chunk=4,
+                   strategy=strat)
+    assert eng.decode_model._plan_source == "manual"
+    ck = eng.decode_model._state["l0_attn"]["cache_k"]
+    assert ck.sharding.spec == P("data", None, "model")
+    # 4 slots over data=2: the slot dim is genuinely sharded too
+    assert ck.sharding.shard_shape(ck.shape)[0] == 2
+    sharded = eng.generate(PROMPTS[:2])
+
+    ff1 = _build_lm(mesh=(1, 1, 1, 1), batch=1)
+    eng1 = ff1.serve(slots=4, max_new_tokens=5, prefill_chunk=4)
+    assert eng1.generate(PROMPTS[:2]) == sharded
+
+
+def test_eos_and_max_len_completion():
+    """All three completion rules: eos (stop token sampled), max_tokens
+    (budget), and length (KV cache full)."""
+    ff = _build_lm(batch=1)
+    eng = ff.serve(slots=2, max_new_tokens=10, prefill_chunk=4)
+    prompt = PROMPTS[0]
+    # discover what greedy generates, then replay with its second token
+    # as the stop token
+    (gen,) = eng.generate([prompt])
+    eos = gen[1]
+    req = eng.submit(prompt, eos_id=eos)
+    eng.run_until_drained()
+    assert req.finished and req.finish_reason == "eos"
+    assert req.generated[-1] == eos and len(req.generated) == 2
+
+    req2 = eng.submit(prompt, max_new_tokens=3)
+    eng.run_until_drained()
+    assert req2.finish_reason == "max_tokens"
+    assert len(req2.generated) == 3 and req2.generated == gen[:3]
+
+    # cache capacity: prompt of 6 into an 8-row cache leaves room to feed
+    # 2 generated tokens back; the 3rd sampled token cannot be fed
+    small = ff.serve(slots=2, max_new_tokens=10, prefill_chunk=4,
+                     max_seq_len=8)
+    req3 = small.submit([1, 2, 3, 4, 5, 6])
+    small.run_until_drained()
+    assert req3.finish_reason == "length"
+    assert len(req3.generated) == 3
+    # oversized prompts are rejected at submission
+    with pytest.raises(ValueError):
+        small.submit(list(range(9)))
+
+
+class _SearchSpy:
+    """Counts UnitySearch.evaluate + joint_graph_optimize calls (the
+    test_warmstart.py hook, reused for the serving acceptance check)."""
+
+    def __enter__(self):
+        import flexflow_tpu.search.joint as joint
+        import flexflow_tpu.search.unity as unity
+
+        self.evals = 0
+        self.searches = 0
+        self._unity, self._joint = unity, joint
+        self._orig_eval = unity.UnitySearch.evaluate
+        self._orig_opt = joint.joint_graph_optimize
+        spy = self
+
+        def eval_spy(us, *a, **kw):
+            spy.evals += 1
+            return spy._orig_eval(us, *a, **kw)
+
+        def opt_spy(*a, **kw):
+            spy.searches += 1
+            return spy._orig_opt(*a, **kw)
+
+        unity.UnitySearch.evaluate = eval_spy
+        joint.joint_graph_optimize = opt_spy
+        return self
+
+    def __exit__(self, *exc):
+        self._unity.UnitySearch.evaluate = self._orig_eval
+        self._joint.joint_graph_optimize = self._orig_opt
+        return False
+
+
+def test_serving_warmstart_plan_cache_hit(tmp_path):
+    """Second serving compile of the same (model, slots, max_seq, mesh)
+    against one --warmstart-dir: plan_source=cache, 0 evaluate calls,
+    0 searches, and token-identical output (the acceptance criterion)."""
+    ws = str(tmp_path / "ws")
+    ff = _build_lm(mesh=(2, 4, 1, 1), batch=8,
+                   argv=["--only-data-parallel"])
+    ov = dict(only_data_parallel=False, search_budget=4,
+              enable_parameter_parallel=True,
+              enable_attribute_parallel=True, warmstart_dir=ws)
+    kw = dict(slots=8, max_new_tokens=4, prefill_chunk=4,
+              config_overrides=ov)
+    eng1 = ff.serve(**kw)
+    assert eng1.decode_model._plan_source == "search"
+    out1 = eng1.generate(PROMPTS[:2])
+
+    with _SearchSpy() as spy:
+        eng2 = ff.serve(**kw)
+    assert spy.searches == 0, "serving plan-cache hit must not re-search"
+    assert spy.evals == 0, "serving plan-cache hit must cost 0 evaluations"
+    assert eng2.decode_model._plan_source == "cache"
+    assert eng2.generate(PROMPTS[:2]) == out1
+
+    # a different bucket geometry (slots) is a different decode graph —
+    # it must NOT be served by the cached plan
+    with _SearchSpy() as spy:
+        eng3 = ff.serve(slots=4, max_new_tokens=4, prefill_chunk=4,
+                        config_overrides=ov)
+    assert eng3.decode_model._plan_source == "search"
+    assert spy.searches == 1
+
+
+def test_serving_telemetry_artifacts(tmp_path):
+    """With a telemetry session attached, serving emits the serve.compile
+    event (plan_source), per-request serve.request events with TTFT, and
+    a serve.summary with requests/s/chip + decode tokens/s/chip."""
+    ff = _build_lm(batch=1)
+    ff.enable_telemetry(str(tmp_path / "tel"))
+    eng = ff.serve(slots=2, max_new_tokens=4, prefill_chunk=4)
+    eng.generate(PROMPTS[:3])
+    eng.telemetry.close()
+
+    from flexflow_tpu.telemetry import read_jsonl
+
+    recs = read_jsonl(str(tmp_path / "tel" / "metrics.jsonl"))
+    compiles = [r for r in recs if r["kind"] == "serve.compile"]
+    assert compiles and compiles[0]["plan_source"] == "default"
+    assert compiles[0]["slots"] == 2
+    reqs = [r for r in recs if r["kind"] == "serve.request"]
+    assert len(reqs) == 3
+    for r in reqs:
+        assert r["ttft_s"] > 0 and r["new_tokens"] == 4
+        assert r["finish_reason"] == "max_tokens"
+    summaries = [r for r in recs if r["kind"] == "serve.summary"]
+    assert summaries
+    s = summaries[-1]
+    assert s["requests_per_sec_per_chip"] > 0
+    assert s["decode_tokens_per_sec_per_chip"] > 0
+    assert s["requests_completed"] == 3
+
+    import json
+
+    with open(tmp_path / "tel" / "trace.json") as f:
+        names = {e["name"] for e in json.load(f)["traceEvents"]}
+    for span in ("serve.compile", "serve.prefill", "serve.step"):
+        assert span in names, f"trace missing {span!r}"
+
+
+def test_model_zoo_decode_builder_matches_replay():
+    """models.build_transformer_lm_decode expresses the same decode graph
+    the serving replay derives: same node names, op types, and KV-cache
+    shapes — the zoo can build the decode graph without forking the
+    training definition."""
+    sys.argv = ["test"]
+    from flexflow_tpu import CompMode, FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.fftype import OperatorType as OT
+    from flexflow_tpu.models import build_transformer_lm_decode
+    from flexflow_tpu.serving import ServingSpec, build_decode_model
+
+    c = _lm_config()
+    ff = _build_lm(batch=1)
+    dec, max_seq = build_decode_model(ff, ServingSpec(slots=2))
+    assert max_seq == c.sequence_length
+
+    cfg = FFConfig()
+    cfg.mesh_axis_sizes = (1, 1, 1, 1)
+    zoo = FFModel(cfg)
+    build_transformer_lm_decode(zoo, c, slots=2)
+    zoo.compile(optimizer=SGDOptimizer(lr=0.0),
+                loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                comp_mode=CompMode.COMP_MODE_INFERENCE)
+
+    def sig(model):
+        return [(n.name, n.op_type.name,
+                 tuple(tuple(ws.shape) for ws in n.weight_specs
+                       if not ws.trainable))
+                for n in model.graph.topo_order()]
+
+    assert sig(zoo) == sig(dec)
+    attn = [n for n in zoo.graph.topo_order()
+            if n.op_type == OT.OP_INC_MULTIHEAD_ATTENTION]
+    assert len(attn) == c.num_layers
+    cache = next(ws for ws in attn[0].weight_specs if not ws.trainable)
+    assert cache.shape == (2, c.sequence_length + 1, c.hidden_size)
+
+
+def test_flash_decode_kernel_matches_reference():
+    """The Pallas single-query decode kernel (interpret mode on CPU)
+    matches the einsum reference across partial/full/one-token cache
+    fills. Converted to a clean skip by the conftest capability probe
+    when the environment lacks the Pallas APIs."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.kernels.flash_attention import (
+        decode_attention_reference,
+        flash_decode_attention,
+    )
+
+    rs = np.random.RandomState(0)
+    slots, S, H, hd = 3, 256, 2, 64
+    E = H * hd
+    q = jnp.asarray(rs.randn(slots, 1, E), jnp.float32)
+    k = jnp.asarray(rs.randn(slots, S, E), jnp.float32)
+    v = jnp.asarray(rs.randn(slots, S, E), jnp.float32)
+    lengths = jnp.asarray([1, 100, 256], jnp.int32)
+    ref = decode_attention_reference(q, k, v, (lengths - 1)[:, None],
+                                     num_heads=H)
+    out = flash_decode_attention(q, k, v, lengths, num_heads=H,
+                                 block_k=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
