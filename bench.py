@@ -38,9 +38,13 @@ def _peak_flops(device) -> float:
     return 2e12  # CPU fallback so the harness still runs
 
 
-def _measure_lm(cfg, batch: int, steps: int, warmup: int, on_tpu: bool):
+def _measure_lm(cfg, batch: int, steps: int, warmup: int, on_tpu: bool,
+                tune=None):
     """(tokens/s, MFU) of one LM training config, or (None, None) when
-    every retry reads as a backend fluke (>100% MFU)."""
+    every retry reads as a backend fluke (>100% MFU). `tune(config)`, when
+    given, mutates the FFConfig before the model is built — the ablation
+    legs use it to flip kernel layout / collective-overlap / mesh knobs
+    against an otherwise identical measurement."""
     import jax
 
     from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
@@ -56,6 +60,8 @@ def _measure_lm(cfg, batch: int, steps: int, warmup: int, on_tpu: bool):
         from flexflow_tpu.fftype import DataType
 
         config.computation_dtype = DataType.DT_BFLOAT16
+    if tune is not None:
+        tune(config)
     ff = FFModel(config)
     build_transformer_lm(ff, cfg, batch_size=batch)
     with telemetry.span("bench.compile", seq=cfg.sequence_length):
@@ -215,6 +221,70 @@ def _fit_loop_legs(cfg, batch: int, on_tpu: bool,
         "pipeline_steps": pipeline_steps,
         "speedup": round(piped / eager, 4) if eager > 0 else None,
     }
+
+
+def _attention_ablation_legs(lcfg, batch: int, steps: int, warmup: int,
+                             on_tpu: bool, packed_tps) -> dict:
+    """seq-4096 attention-ablation legs: attribute the long-context gain
+    to its round-7 components (docs/performance.md "Long-context path").
+
+    - flash_packed vs flash_transposed: the relayout-free packed kernels
+      (lane-offset / head-group BlockSpecs on the (b, s, h·d) projection
+      layout) vs the head-transposed kernels whose (b,s,h,d)↔(b,h,s,d)
+      copies PERF.md measured at ~0.8 ms/step on the flagship.
+    - ring_overlap vs ring_serial: the sequence-parallel ring path with
+      the double-buffered hop-before-compute ppermute pipeline vs the
+      serial compute-then-hop ablation (--no-overlap-collectives), seq
+      axis sharded over every local device. Skipped (null) on one chip —
+      there is no ring to overlap.
+
+    All legs reuse the slope methodology of `_measure_lm`; the packed
+    reading is the already-measured seq-4096 leg, passed in so the
+    number of record and its ablation baseline come from one run."""
+    import dataclasses
+
+    import jax
+
+    legs = {
+        "flash_packed_tokens_per_sec":
+            None if packed_tps is None else round(packed_tps, 2),
+    }
+    tps_t, _ = _measure_lm(
+        lcfg, batch, steps, warmup, on_tpu,
+        tune=lambda c: setattr(c, "flash_packed_layout", False))
+    legs["flash_transposed_tokens_per_sec"] = (
+        None if tps_t is None else round(tps_t, 2))
+    if packed_tps and tps_t:
+        legs["packed_vs_transposed"] = round(packed_tps / tps_t, 4)
+
+    n = jax.local_device_count()
+    if n > 1:
+        rcfg = dataclasses.replace(lcfg, attention_impl="ring")
+
+        def ring_tune(overlap):
+            def tune(c):
+                c.mesh_axis_sizes = (1, 1, 1, n)  # data,model,pipe,seq
+                c.enable_sample_parallel = True
+                c.search_budget = 4
+                c.overlap_collectives = overlap
+
+            return tune
+
+        for name, overlap in (("ring_overlap", True),
+                              ("ring_serial", False)):
+            tps_r, _ = _measure_lm(rcfg, batch, steps, warmup, on_tpu,
+                                   tune=ring_tune(overlap))
+            legs[f"{name}_tokens_per_sec"] = (
+                None if tps_r is None else round(tps_r, 2))
+        ro = legs.get("ring_overlap_tokens_per_sec")
+        rs = legs.get("ring_serial_tokens_per_sec")
+        if ro and rs:
+            legs["overlap_vs_serial"] = round(ro / rs, 4)
+        legs["ring_seq_shards"] = n
+    else:
+        legs["ring_overlap_tokens_per_sec"] = None
+        legs["ring_serial_tokens_per_sec"] = None
+    return legs
 
 
 def _warmstart_legs() -> dict:
@@ -408,6 +478,7 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
 
     tokens_per_sec, mfu = _measure_lm(cfg, batch, steps, warmup, on_tpu)
 
+    seq4096 = None
     if on_tpu and tokens_per_sec is not None:
         # secondary LONG-CONTEXT leg (seq 4096, same model family): the
         # regime where flash's causal block-skipping and the online-softmax
@@ -425,12 +496,23 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
             tps4k, mfu4k = _measure_lm(lcfg, batch=1, steps=5, warmup=1,
                                        on_tpu=on_tpu)
             if tps4k is not None:
-                print(json.dumps({
+                seq4096 = {
                     "metric": "transformer_lm_tokens_per_sec_per_chip_seq4096",
                     "value": round(tps4k, 2),
                     "unit": "tokens/s",
                     "vs_baseline": round(mfu4k / 0.35, 4),
-                }))
+                }
+                # attention-ablation legs (round 7): transposed vs packed
+                # kernel, ring overlap on/off — the BENCH payload must
+                # attribute the long-context number to its components
+                try:
+                    seq4096["ablation"] = _attention_ablation_legs(
+                        lcfg, batch=1, steps=5, warmup=1, on_tpu=on_tpu,
+                        packed_tps=tps4k)
+                except Exception as e:  # pragma: no cover - defensive
+                    print(f"bench: attention ablation failed: {e}",
+                          file=sys.stderr)
+                print(json.dumps(seq4096))
             else:
                 print("bench: long-context leg read as fluke, skipped",
                       file=sys.stderr)
@@ -500,6 +582,8 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
         "unit": "tokens/s",
         "vs_baseline": None if tokens_per_sec is None else round(mfu / 0.35, 4),
     }
+    if seq4096 is not None:
+        payload["seq4096"] = seq4096
     if fit_loop is not None:
         payload["fit_loop"] = fit_loop
     if serving is not None:

@@ -61,6 +61,19 @@ class FFConfig:
     # re-factorizing the mesh, search/mesh_search.py); the searched shape
     # replaces the configured data/model split
     search_mesh_shapes: bool = False
+    # overlap-capable collectives (ring attention's double-buffered
+    # ppermute pipeline, the decomposed collective matmul): True prices
+    # and schedules them overlapped with compute — max(compute, comm) in
+    # the cost model, hop-before-compute in the runtime; False restores
+    # the serial compute+comm pricing and schedule (the ablation
+    # baseline, bench.py's ring legs)
+    overlap_collectives: bool = True
+    # flash attention layout: True (default) runs the packed relayout-free
+    # kernels on the (b, s, h·d) projection layout; False forces the
+    # head-transposed kernels — the (b,s,h,d)→(b,h,s,d) HBM relayout
+    # ablation baseline (bench.py's seq-4096 kernel legs, PERF.md's
+    # ~0.8 ms/step copies)
+    flash_packed_layout: bool = True
     # parallelism gates (reference config.h:133-137)
     only_data_parallel: bool = False
     enable_sample_parallel: bool = False
@@ -262,6 +275,10 @@ class FFConfig:
                 self.enable_inplace_optimizations = True
             elif a == "--search-overlap-backward-update":
                 self.search_overlap_backward_update = True
+            elif a == "--no-overlap-collectives":
+                self.overlap_collectives = False
+            elif a == "--flash-transposed":
+                self.flash_packed_layout = False
             elif a == "--fusion":
                 self.perform_fusion = True
             elif a == "--profiling":

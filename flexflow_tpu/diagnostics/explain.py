@@ -65,9 +65,12 @@ def verify_report_total(report: dict) -> float:
     src = [e[0] for e in report["edges"]]
     dst = [e[1] for e in report["edges"]]
     total = graph_makespan(compute, comm, src, dst, axis=axis)
-    if report.get("overlap_sync"):
-        # the _MakespanAccum.makespan overlapped-sync bound: sync time on
-        # an axis serializes with that axis's path comm
+    has_overlap = any(o.get("overlap_s", 0.0) > 0.0 for o in ops)
+    if report.get("overlap_sync") or has_overlap:
+        # the _MakespanAccum.makespan per-axis bounds: overlapped traffic
+        # (ring-attention hops hidden behind compute, overlapped gradient
+        # sync) still occupies its ICI axis's links, so same-axis serial +
+        # overlapped (+ sync) comm serialize against each other
         sync_by_axis: dict[int, float] = {}
         comm_by_axis: dict[int, float] = {}
         for o in ops:
@@ -76,9 +79,14 @@ def verify_report_total(report: dict) -> float:
                     sync_by_axis.get(o["comm_axis_id"], 0.0) + o["sync_s"])
             if o["comm_axis_id"] >= 0:
                 comm_by_axis[o["comm_axis_id"]] = (
-                    comm_by_axis.get(o["comm_axis_id"], 0.0) + o["comm_s"])
-        for ax, s in sync_by_axis.items():
-            total = max(total, s + comm_by_axis.get(ax, 0.0))
+                    comm_by_axis.get(o["comm_axis_id"], 0.0)
+                    + o["comm_s"] + o.get("overlap_s", 0.0))
+        if has_overlap:
+            for ax, c in comm_by_axis.items():
+                total = max(total, c)
+        if report.get("overlap_sync"):
+            for ax, s in sync_by_axis.items():
+                total = max(total, s + comm_by_axis.get(ax, 0.0))
     return total
 
 
@@ -253,6 +261,7 @@ def build_strategy_report(model) -> dict:
             "forward_s": d["forward_s"], "backward_s": d["backward_s"],
             "comm_s": d["comm_s"],
             "reshard_s": d["reshard_s"], "collective_s": d["collective_s"],
+            "overlap_s": d.get("overlap_s", 0.0),
             "sync_s": d["sync_s"],
             "comm_axis_id": d["comm_axis_id"],
             "memory_bytes": d["memory_bytes"],

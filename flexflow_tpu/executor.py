@@ -121,6 +121,13 @@ class Executor:
         """Shared mixed-precision loss closure for the fused train step and
         the granular FFModel.backward: bf16 compute casts on params/inputs
         (state is passed uncast — ops own their fp32-statistics handling).
+        Params are passed UNCAST into `_apply`, which casts each node's
+        weights at their first use — the cast fuses into the consumer's
+        matmul prologue instead of materializing a full bf16 parameter
+        copy through HBM every step (PERF.md "remaining headroom": the
+        per-step fp32-master downcast traffic). The VJP is unchanged (a
+        per-leaf astype either way), so gradients still accumulate into
+        the fp32 masters bit-identically.
         Logits stay in the compute dtype — the loss reduces them with f32
         accumulation internally (loss.py), so no logits-sized f32 tensor is
         materialized. aux carries (logits, new_state, ce_sum): ce_sum is the
@@ -130,7 +137,7 @@ class Executor:
 
         def loss_fn(p):
             logits, new_state, aux = self._apply(
-                self._cast_compute(p), state, xc, training=True, rng=rng
+                p, state, xc, training=True, rng=rng
             )
             l, ce_sum = loss_terms(
                 self.loss_type, logits, labels, self.last_op_is_softmax
@@ -216,7 +223,12 @@ class Executor:
             # then sums every use's gradient into that one set
             wsrc = getattr(node, "weight_source", None) or node.name
             weights = {}
-            weights.update(params.get(wsrc, {}))
+            # bf16 cast at the consumer: each node casts only its own
+            # weights, so XLA fuses the downcast into the first use
+            # instead of writing a model-sized bf16 copy to HBM up front
+            # (state stays uncast — ops own their fp32-statistics
+            # handling)
+            weights.update(self._cast_compute(params.get(wsrc, {})))
             weights.update(new_state.get(wsrc, {}))
             ctx = OpContext(
                 training=training,
@@ -225,6 +237,8 @@ class Executor:
                 profiling=self.config.profiling,
                 mesh=self.mesh,
                 matmul_dtype=self.matmul_dtype,
+                overlap_collectives=self.config.overlap_collectives,
+                flash_packed=self.config.flash_packed_layout,
             )
             op_state = new_state.get(node.name)
             # named_scope labels the op in XLA profiles (the analog of the
@@ -322,7 +336,7 @@ class Executor:
         def eval_step(params, state, counters, batch):
             x_inputs, labels = batch
             logits, _, _ = self._apply(
-                self._cast_compute(params), state,
+                params, state,
                 self._cast_compute(x_inputs), training=False, rng=None,
             )
             counters = self.metrics.compute(
@@ -349,7 +363,7 @@ class Executor:
 
         def decode_step(params, state, x_inputs, read_idx, rng, temperature):
             logits, new_state, _ = self._apply(
-                self._cast_compute(params), state,
+                params, state,
                 self._cast_compute(x_inputs), training=False, rng=None,
             )
             slots = logits.shape[0]
@@ -369,7 +383,7 @@ class Executor:
     def build_forward(self):
         def forward(params, state, x_inputs, training):
             logits, new_state, _ = self._apply(
-                self._cast_compute(params), state,
+                params, state,
                 self._cast_compute(x_inputs), training=training,
                 rng=jax.random.key(0),
             )

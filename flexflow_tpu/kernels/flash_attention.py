@@ -42,6 +42,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Older jax spells pltpu.CompilerParams as TPUCompilerParams (same
+# dimension_semantics field); resolve once so the kernels — and the
+# interpret-mode CPU test suite — run on both.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 # Minor dim of the (seq,) row-stat tensors (lse/delta): Mosaic wants
 # 128-lane minor blocks for f32 (the in-tree jax flash kernel's
@@ -255,7 +261,7 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=scratch_shapes,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=jax.default_backend() != "tpu",
@@ -268,13 +274,15 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
     return out.reshape(b, h, s_q, d), lse
 
 
-def _bwd_tile(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i, j, masked,
+def _bwd_tile_math(
+    q, k, v, do, lse, delta, i, j, masked,
     *, scale: float, causal: bool, block_q: int, block_k: int,
     seq_q: int, seq_k: int, causal_offset: int, mask_q_rows: bool,
 ):
-    """Shared backward tile recompute: rebuild the probability tile p from
-    (q, k, lse) and form ds = p*(dp - delta)*scale.
+    """Shared backward tile recompute on plain arrays: rebuild the
+    probability tile p from (q, k, lse) and form ds = p*(dp - delta)*scale.
+    Shared between the per-head ref-loading wrapper (`_bwd_tile`) and the
+    grouped narrow-head kernels, which load lane sub-slices per head.
 
     Padded-row handling is static: q-row zeroing only exists when seq_q is
     ragged against block_q (garbage rows are NaN in interpret mode and
@@ -286,12 +294,6 @@ def _bwd_tile(
     discarded on write."""
     even_q = seq_q % block_q == 0
     even_k = seq_k % block_k == 0
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0][:, 0]
-    delta = delta_ref[0][:, 0]
     q_valid = None
     if not even_q:
         q_valid = jax.lax.broadcasted_iota(
@@ -329,6 +331,21 @@ def _bwd_tile(
     )
     ds = p * (dp - delta[:, None]) * scale
     return q, k, v, do, p, ds
+
+
+def _bwd_tile(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i, j, masked,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+    seq_q: int, seq_k: int, causal_offset: int, mask_q_rows: bool,
+):
+    """Ref-loading wrapper around `_bwd_tile_math` for the per-head
+    kernels (one head per block; leading singleton block dim)."""
+    return _bwd_tile_math(
+        q_ref[0], k_ref[0], v_ref[0], do_ref[0],
+        lse_ref[0][:, 0], delta_ref[0][:, 0], i, j, masked,
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        seq_q=seq_q, seq_k=seq_k, causal_offset=causal_offset,
+        mask_q_rows=mask_q_rows)
 
 
 def _bwd_dq_kernel(
@@ -477,7 +494,7 @@ def _flash_bwd_single_tile(qf, kf, vf, gf, lse, delta, causal, scale,
             jax.ShapeDtypeStruct((bh, s_k, d), kf.dtype),
             jax.ShapeDtypeStruct((bh, s_k, d), vf.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",),
         ),
         interpret=jax.default_backend() != "tpu",
@@ -485,7 +502,13 @@ def _flash_bwd_single_tile(qf, kf, vf, gf, lse, delta, causal, scale,
     )(qf, kf, vf, gf, lse, delta)
 
 
-def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
+def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+               delta_adj=None):
+    """`delta_adj` (b, h, s_q), when given, is SUBTRACTED from delta before
+    the kernels run: the lse cotangent of the with-lse forward. Derivation:
+    ∂lse_i/∂s_ij = p_ij, so a g_lse cotangent adds p·g_lse to ds — i.e.
+    ds = p·(dp − (delta − g_lse)), a pure delta shift. dv = pᵀ·do is
+    unaffected, so the same dq/dkv kernels serve both VJPs."""
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     bq = min(block_q, s_q)
@@ -499,6 +522,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
         gf.astype(jnp.float32) * out.reshape(b * h, s_q, d).astype(jnp.float32),
         axis=-1,
     )
+    if delta_adj is not None:
+        delta = delta - delta_adj.reshape(b * h, s_q).astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (b * h, s_q, LSE_LANES))
     interpret = jax.default_backend() != "tpu"
     ni = pl.cdiv(s_q, bq)
@@ -525,7 +550,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -548,7 +573,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -581,6 +606,70 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+# ----------------------------------------------------- (out, lse) variant
+# Ring attention combines per-block partial softmaxes across K/V rotations
+# (parallel/ring_attention.py): each block contributes (out_blk, lse_blk)
+# and the online merge is out = Σ out_blk·exp(lse_blk − lse) with
+# lse = logaddexp over blocks. Both outputs carry gradients (the merge
+# weights depend on lse), so this variant's VJP folds the lse cotangent
+# into delta (see _flash_bwd) instead of inventing a second backward.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    b, h, s_q, _ = q.shape
+    return out, lse[:, :, 0].reshape(b, h, s_q)
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    b, h, s_q, _ = q.shape
+    return ((out, lse[:, :, 0].reshape(b, h, s_q)),
+            (q, k, v, out, lse))
+
+
+def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    return _flash_bwd(q, k, v, out, lse, g_out, causal, scale,
+                      block_q, block_k, delta_adj=g_lse)
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def _attn_reference_lse(q, k, v, causal: bool, scale: float):
+    """XLA-path (out, lse) with sdpa_xla's exact masking convention — the
+    small-shape fallback of flash_attention_with_lse. lse over masked
+    (-1e30) logits matches the kernel's live-keys logsumexp to f32 eps."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v), lse
+
+
+def flash_attention_with_lse(
+    q, k, v, *, causal: bool = False, scale: float | None = None,
+    block_q: int = 512, block_k: int = 512,
+):
+    """Fused attention returning (out, lse). q,k,v: (b, h, s, d); lse:
+    (b, h, s_q) float32 row logsumexp of the scaled (masked) logits.
+    Differentiable in BOTH outputs (the lse cotangent folds into delta in
+    the shared FA2 backward). Same shape gates as flash_attention."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s_q, s_k, d = q.shape[2], k.shape[2], q.shape[3]
+    if s_q < 128 or s_k < 128 or d % 8 != 0 or (causal and s_q > s_k):
+        return _attn_reference_lse(q, k, v, causal, scale)
+    return _flash_lse(q, k, v, causal, scale, block_q, block_k)
+
+
 # --------------------------------------------------------- packed layout
 # (b, s, h·dh) activations end to end: the qkv projection's natural output
 # layout. Heads are selected by BlockSpec lane-offset index maps — block
@@ -588,6 +677,289 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 # touches HBM (PERF.md measured the (b,s,h,d)→(b,h,s,d) copies at ~0.8 ms
 # per flagship step). The kernel bodies are shared with the bhsd path; only
 # the grids ((b, h, qi, kj)) and index maps differ.
+#
+# NARROW HEADS (head_dim < 128): Mosaic requires a lane block be a multiple
+# of 128 lanes (or the full array width), so a single head_dim-64 head
+# cannot be its own block — the old gate routed those models through the
+# transposed layout and paid the relayout. The grouped path below removes
+# that: blocks take a GROUP of `hpb` consecutive heads per 128-lane stripe
+# (hpb = 128/dh when dh | 128, else all heads — full array width, legal for
+# any dh), the grid gains a head-GROUP dimension, and the kernel bodies
+# loop statically over the group's heads via lane sub-slices — the same
+# (b, s, h, d) block semantics as a 4-D BlockSpec with a head grid dim,
+# expressed on the 3-D packed array so no reshape/relayout ever runs.
+
+
+def _packed_heads_per_block(head_dim: int, num_heads: int) -> int:
+    """Heads per lane block for the packed path. 1 = the classic one-head
+    lane-offset blocks (head_dim % 128 == 0); >1 = the grouped narrow-head
+    path. Always yields a Mosaic-legal lane width: hpb·dh is either a
+    multiple of 128 or the full (h·dh) array width."""
+    if head_dim % 128 == 0:
+        return 1
+    if 128 % head_dim == 0 and num_heads % (128 // head_dim) == 0:
+        return 128 // head_dim
+    return num_heads
+
+
+def _flash_kernel_grouped(
+    q_ref, k_ref, v_ref, o_ref, *refs,
+    scale: float, causal: bool, block_q: int, block_k: int, seq_k: int,
+    causal_offset: int, save_lse: bool, nj: int, hpb: int, head_dim: int,
+):
+    """Forward tile for a HEAD GROUP: same online-softmax math as
+    _flash_kernel, looped statically over the hpb heads of the block's
+    lane stripe. Row stats live per head ((hpb, bq) scratch); the
+    accumulator shares the block's (bq, hpb·dh) lane layout."""
+    even_k = seq_k % block_k == 0
+    single_kv = nj == 1
+    if save_lse:
+        lse_ref = refs[0]
+        refs = refs[1:]
+    else:
+        lse_ref = None
+    if not single_kv:
+        m_ref, l_ref, acc_ref = refs
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    def step(masked: bool):
+        mask = v_valid = None
+        if masked:
+            mask = _tile_mask(
+                i, j, causal=causal, block_q=block_q, block_k=block_k,
+                seq_k=seq_k, causal_offset=causal_offset, even_k=even_k,
+            )
+            if not even_k:
+                v_valid = jax.lax.broadcasted_iota(
+                    jnp.int32, (block_k, head_dim), 0
+                ) + j * block_k < seq_k
+        for hh in range(hpb):
+            sl = slice(hh * head_dim, (hh + 1) * head_dim)
+            q = q_ref[0][:, sl]
+            k = k_ref[0][:, sl]
+            v = v_ref[0][:, sl]
+            logits = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if masked:
+                logits = jnp.where(mask, logits, NEG_INF)
+                if not even_k:
+                    v = jnp.where(v_valid, v, 0.0)
+            if single_kv:
+                m = logits.max(axis=-1)
+                p = jnp.exp(logits - m[:, None])
+                l = p.sum(axis=-1)
+                acc = jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                o_ref[0, :, sl] = (acc / l[:, None]).astype(o_ref.dtype)
+                if save_lse:
+                    lse_ref[hh] = jnp.broadcast_to(
+                        (m + jnp.log(l))[:, None], lse_ref.shape[1:])
+            else:
+                m_prev = m_ref[hh]
+                m_new = jnp.maximum(m_prev, logits.max(axis=-1))
+                p = jnp.exp(logits - m_new[:, None])
+                alpha = jnp.exp(m_prev - m_new)
+                l_ref[hh] = l_ref[hh] * alpha + p.sum(axis=-1)
+                acc_ref[:, sl] = (acc_ref[:, sl] * alpha[:, None]
+                                  + jax.lax.dot_general(
+                                      p.astype(v.dtype), v,
+                                      (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32))
+                m_ref[hh] = m_new
+
+    if single_kv:
+        step(causal or not even_k)
+        return
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    live, needs_mask = _tile_classes(
+        i, j, causal=causal, block_q=block_q, block_k=block_k,
+        causal_offset=causal_offset, even_k=even_k, nj=nj,
+    )
+    if causal or not even_k:
+        pl.when(jnp.logical_and(live, needs_mask))(lambda: step(True))
+        pl.when(jnp.logical_and(live, jnp.logical_not(needs_mask)))(
+            lambda: step(False))
+    else:
+        step(False)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        for hh in range(hpb):
+            sl = slice(hh * head_dim, (hh + 1) * head_dim)
+            o_ref[0, :, sl] = (acc_ref[:, sl]
+                               / l_ref[hh][:, None]).astype(o_ref.dtype)
+            if save_lse:
+                lse_ref[hh] = jnp.broadcast_to(
+                    (m_ref[hh] + jnp.log(l_ref[hh]))[:, None],
+                    lse_ref.shape[1:])
+
+
+def _bwd_dq_kernel_grouped(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+    seq_q: int, seq_k: int, causal_offset: int, nj: int,
+    hpb: int, head_dim: int,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def step(masked: bool):
+        for hh in range(hpb):
+            sl = slice(hh * head_dim, (hh + 1) * head_dim)
+            _, k, _, _, _, ds = _bwd_tile_math(
+                q_ref[0][:, sl], k_ref[0][:, sl], v_ref[0][:, sl],
+                do_ref[0][:, sl], lse_ref[hh][:, 0], delta_ref[hh][:, 0],
+                i, j, masked,
+                scale=scale, causal=causal, block_q=block_q,
+                block_k=block_k, seq_q=seq_q, seq_k=seq_k,
+                causal_offset=causal_offset,
+                mask_q_rows=False,  # padded dq rows are discarded on write
+            )
+            dq_acc[:, sl] += jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    live, needs_mask = _tile_classes(
+        i, j, causal=causal, block_q=block_q, block_k=block_k,
+        causal_offset=causal_offset, even_k=seq_k % block_k == 0, nj=nj,
+    )
+    if causal or seq_k % block_k != 0:
+        pl.when(jnp.logical_and(live, needs_mask))(lambda: step(True))
+        pl.when(jnp.logical_and(live, jnp.logical_not(needs_mask)))(
+            lambda: step(False))
+    else:
+        step(False)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_grouped(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+    seq_q: int, seq_k: int, causal_offset: int, ni: int, nj: int,
+    hpb: int, head_dim: int,
+):
+    j = pl.program_id(2)  # kv block
+    i = pl.program_id(3)  # q block (innermost, sequential)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def step(masked: bool):
+        for hh in range(hpb):
+            sl = slice(hh * head_dim, (hh + 1) * head_dim)
+            q, _, _, do, p, ds = _bwd_tile_math(
+                q_ref[0][:, sl], k_ref[0][:, sl], v_ref[0][:, sl],
+                do_ref[0][:, sl], lse_ref[hh][:, 0], delta_ref[hh][:, 0],
+                i, j, masked,
+                scale=scale, causal=causal, block_q=block_q,
+                block_k=block_k, seq_q=seq_q, seq_k=seq_k,
+                causal_offset=causal_offset,
+                mask_q_rows=True,  # padded q rows would leak p==1 into dk/dv
+            )
+            dv_acc[:, sl] += jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dk_acc[:, sl] += jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    live, needs_mask = _tile_classes(
+        i, j, causal=causal, block_q=block_q, block_k=block_k,
+        causal_offset=causal_offset, even_k=seq_k % block_k == 0, nj=nj,
+    )
+    if causal or seq_k % block_k != 0:
+        pl.when(jnp.logical_and(live, needs_mask))(lambda: step(True))
+        pl.when(jnp.logical_and(live, jnp.logical_not(needs_mask)))(
+            lambda: step(False))
+    else:
+        step(False)
+
+    @pl.when(i == ni - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_fwd_packed_grouped(q, k, v, num_heads, causal, scale,
+                              block_q, block_k, hpb, save_lse=True):
+    """Narrow-head forward: head-GROUP lane blocks (hpb heads per block,
+    width hpb·d = 128-multiple or full array width) over the 3-D packed
+    array, grid (b, head-groups, q-blocks, kv-blocks)."""
+    b, s_q, e = q.shape
+    s_k = k.shape[1]
+    h = num_heads
+    d = e // h
+    ng = h // hpb
+    bq = min(block_q, s_q)
+    bk = min(block_k, s_k)
+    nj = pl.cdiv(s_k, bk)
+    grid = (b, ng, pl.cdiv(s_q, bq), nj)
+    kernel = functools.partial(
+        _flash_kernel_grouped, scale=scale, causal=causal, block_q=bq,
+        block_k=bk, seq_k=s_k, causal_offset=s_k - s_q, save_lse=save_lse,
+        nj=nj, hpb=hpb, head_dim=d,
+    )
+    w = hpb * d
+    qspec = pl.BlockSpec((1, bq, w), lambda bi, gi, i, j: (bi, i, gi))
+    kspec = pl.BlockSpec((1, bk, w), lambda bi, gi, i, j: (bi, j, gi))
+    out_specs = [qspec]
+    out_shape = [jax.ShapeDtypeStruct((b, s_q, e), q.dtype)]
+    if save_lse:
+        # per-head row stats in the (b·h, s, LANES) layout; the group's
+        # hpb consecutive head rows form one block
+        out_specs.append(pl.BlockSpec(
+            (hpb, bq, LSE_LANES),
+            lambda bi, gi, i, j: (bi * ng + gi, i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, s_q, LSE_LANES), jnp.float32))
+    scratch_shapes = []
+    if nj > 1:
+        scratch_shapes = [
+            pltpu.VMEM((hpb, bq), jnp.float32),
+            pltpu.VMEM((hpb, bq), jnp.float32),
+            pltpu.VMEM((bq, w), jnp.float32),
+        ]
+    res = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qspec, kspec, kspec],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch_shapes,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=jax.default_backend() != "tpu",
+        name="flash_attention_fwd_packed_grouped",
+    )(q, k, v)
+    if save_lse:
+        return res[0], res[1]
+    return res[0], None
 
 
 def _flash_fwd_packed(q, k, v, num_heads, causal, scale,
@@ -596,6 +968,10 @@ def _flash_fwd_packed(q, k, v, num_heads, causal, scale,
     s_k = k.shape[1]
     h = num_heads
     d = e // h
+    hpb = _packed_heads_per_block(d, h)
+    if hpb > 1:
+        return _flash_fwd_packed_grouped(q, k, v, num_heads, causal, scale,
+                                         block_q, block_k, hpb, save_lse)
     bq = min(block_q, s_q)
     bk = min(block_k, s_k)
     nj = pl.cdiv(s_k, bk)
@@ -630,7 +1006,7 @@ def _flash_fwd_packed(q, k, v, num_heads, causal, scale,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=scratch_shapes,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),
@@ -640,6 +1016,72 @@ def _flash_fwd_packed(q, k, v, num_heads, causal, scale,
     if save_lse:
         return res[0], res[1]
     return res[0], None
+
+
+def _flash_bwd_packed_grouped(q, k, v, g, lse, delta, num_heads, causal,
+                              scale, block_q, block_k, hpb):
+    """Narrow-head dq + dkv kernels on head-group lane blocks (the
+    single-tile fused specialization is per-head-only; grouped shapes
+    route through the split FA2 pair even at one tile)."""
+    b, s_q, e = q.shape
+    s_k = k.shape[1]
+    h = num_heads
+    d = e // h
+    ng = h // hpb
+    w = hpb * d
+    bq = min(block_q, s_q)
+    bk = min(block_k, s_k)
+    ni = pl.cdiv(s_q, bq)
+    nj = pl.cdiv(s_k, bk)
+    interpret = jax.default_backend() != "tpu"
+    common = dict(
+        scale=scale, causal=causal, block_q=bq, block_k=bk,
+        seq_q=s_q, seq_k=s_k, causal_offset=s_k - s_q, hpb=hpb, head_dim=d,
+    )
+    qspec = pl.BlockSpec((1, bq, w), lambda bi, gi, i, j: (bi, i, gi))
+    kspec = pl.BlockSpec((1, bk, w), lambda bi, gi, i, j: (bi, j, gi))
+    rowspec = pl.BlockSpec((hpb, bq, LSE_LANES),
+                           lambda bi, gi, i, j: (bi * ng + gi, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_grouped, nj=nj, **common),
+        grid=(b, ng, ni, nj),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, s_q, e), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, w), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+        name="flash_attention_bwd_dq_packed_grouped",
+    )(q, k, v, g, lse, delta)
+    # kv-grid kernels: block index maps take (b, group, kv_j, q_i)
+    qspec2 = pl.BlockSpec((1, bq, w), lambda bi, gi, j, i: (bi, i, gi))
+    kspec2 = pl.BlockSpec((1, bk, w), lambda bi, gi, j, i: (bi, j, gi))
+    rowspec2 = pl.BlockSpec((hpb, bq, LSE_LANES),
+                            lambda bi, gi, j, i: (bi * ng + gi, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_grouped, ni=ni, nj=nj, **common),
+        grid=(b, ng, nj, ni),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s_k, e), k.dtype),
+            jax.ShapeDtypeStruct((b, s_k, e), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, w), jnp.float32),
+            pltpu.VMEM((bk, w), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+        name="flash_attention_bwd_dkv_packed_grouped",
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 def _flash_bwd_packed(q, k, v, out, lse, g, num_heads, causal, scale,
@@ -658,6 +1100,11 @@ def _flash_bwd_packed(q, k, v, out, lse, g, num_heads, causal, scale,
         axis=-1,
     ).transpose(0, 2, 1).reshape(b * h, s_q)
     delta = jnp.broadcast_to(delta[..., None], (b * h, s_q, LSE_LANES))
+    hpb = _packed_heads_per_block(d, h)
+    if hpb > 1:
+        return _flash_bwd_packed_grouped(q, k, v, g, lse, delta, num_heads,
+                                         causal, scale, block_q, block_k,
+                                         hpb)
     interpret = jax.default_backend() != "tpu"
     ni = pl.cdiv(s_q, bq)
     nj = pl.cdiv(s_k, bk)
@@ -684,7 +1131,7 @@ def _flash_bwd_packed(q, k, v, out, lse, g, num_heads, causal, scale,
                 jax.ShapeDtypeStruct((b, s_k, e), k.dtype),
                 jax.ShapeDtypeStruct((b, s_k, e), v.dtype),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "parallel"),
             ),
             interpret=interpret,
@@ -702,7 +1149,7 @@ def _flash_bwd_packed(q, k, v, out, lse, g, num_heads, causal, scale,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((b, s_q, e), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),
@@ -728,7 +1175,7 @@ def _flash_bwd_packed(q, k, v, out, lse, g, num_heads, causal, scale,
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),
@@ -779,15 +1226,14 @@ def flash_attention_packed(
     if e % num_heads != 0:
         raise ValueError(f"embed dim {e} % heads {num_heads} != 0")
     # Mosaic requires the LAST block dim be a multiple of 128 or the full
-    # array width (lowering.py _check_block_mappings; interpret mode — the
-    # CPU test path — doesn't enforce it, so the gate applies on TPU only)
-    # — head selection by lane offset therefore needs head_dim % 128 == 0
-    # on hardware. Narrower heads route through the transposed-layout
-    # kernel; its head relayout is the price of hd < 128 under this
-    # hardware generation's tiling rules.
-    lane_ok = (d % 128 == 0 or num_heads == 1
-               or jax.default_backend() != "tpu")
-    if s_q < 128 or s_k < 128 or (causal and s_q > s_k) or not lane_ok:
+    # array width (lowering.py _check_block_mappings). head_dim % 128 == 0
+    # satisfies it with one head per block; NARROWER heads now satisfy it
+    # too via head-GROUP blocks (hpb heads per 128-lane stripe, or the
+    # full array width) with an in-kernel static head loop — so head_dim
+    # 64 models run relayout-free where they previously paid the
+    # transposed-layout copies (PERF.md ~0.8 ms/step). Only sub-sublane
+    # head dims (d % 8 != 0) still fall back to the transposed path.
+    if s_q < 128 or s_k < 128 or (causal and s_q > s_k) or d % 8 != 0:
         def split(t, s):
             return t.reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
 
@@ -968,7 +1414,7 @@ def flash_decode_attention(
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((slots, 1, e), q.dtype),
         scratch_shapes=scratch_shapes,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

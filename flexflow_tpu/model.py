@@ -848,6 +848,19 @@ class FFModel:
                 with telemetry.span("compile.calibrate"):
                     cost_model.calibrate_graph(
                         g, top_k=self.config.search_calibrate)
+                    # ring-capable axes: measure the real ppermute hop so
+                    # the overlap-aware sp pricing (and the warm-start DB)
+                    # uses the chip's hop, not the datasheet guess
+                    from .machine import AXIS_SEQ
+
+                    ring_axes = [
+                        ax for ax in (AXIS_SEQ,)
+                        if dict(self.mesh.shape).get(ax, 1) > 1]
+                    if ring_axes:
+                        hops = cost_model.calibrate_collectives(
+                            self.mesh, ring_axes)
+                        telemetry.event("calibrate_collectives",
+                                        axes=ring_axes, measured=hops)
                     stats = getattr(cost_model, "calib_stats", None)
                     if stats is not None:
                         # measured-vs-cache-hit split (the calibration

@@ -106,11 +106,13 @@ def _mha_forward(p: MultiHeadAttentionParams, inputs, weights, state, ctx):
     v = proj(v_in, weights["wv"], weights.get("bv"))
     scale = 1.0 / math.sqrt(hd)
 
-    if p.impl == "flash":
+    if p.impl == "flash" and getattr(ctx, "flash_packed", True):
         # packed layout: the kernel selects heads with lane-offset block
         # index maps, so the projections' (b, s, H·hd) output feeds it
         # directly — no (b,s,h,d)→(b,h,s,d) HBM relayout in fwd OR bwd
-        # (PERF.md measured those copies at ~0.8 ms per flagship step)
+        # (PERF.md measured those copies at ~0.8 ms per flagship step).
+        # ctx.flash_packed=False (--flash-transposed) forces the
+        # head-transposed kernels below — the relayout ablation baseline.
         from ..kernels.flash_attention import flash_attention_packed
 
         out = flash_attention_packed(q, k, v, num_heads=H, causal=p.causal,
@@ -128,7 +130,15 @@ def _mha_forward(p: MultiHeadAttentionParams, inputs, weights, state, ctx):
         from ..parallel.ring_attention import ring_attention
 
         out = ring_attention(q, k, v, causal=p.causal, scale=scale,
-                             mesh=ctx.mesh)
+                             mesh=ctx.mesh,
+                             overlap=getattr(ctx, "overlap_collectives", True))
+    elif p.impl == "flash":
+        # transposed-layout flash (flash_packed=False): same kernel math,
+        # but the head split/merge above materializes the
+        # (b,s,h,d)↔(b,h,s,d) relayouts the packed path avoids
+        from ..kernels.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, causal=p.causal, scale=scale)
     else:
         out = sdpa_xla(q, k, v, causal=p.causal, scale=scale)
 

@@ -52,6 +52,15 @@ class OpContext:
     # (allow_tensor_op_math_conversion, include/flexflow/config.h): inputs
     # are cast to this dtype, accumulation stays fp32.
     matmul_dtype: Any = None
+    # overlap-capable collectives (ring attention's double-buffered hop
+    # pipeline): False compiles the serial compute-then-hop schedule —
+    # the ablation baseline matching the cost model's serial pricing
+    # (FFConfig.overlap_collectives)
+    overlap_collectives: bool = True
+    # False routes impl="flash" attention through the head-transposed
+    # kernels instead of the packed relayout-free path — the kernel-layout
+    # ablation baseline (FFConfig.flash_packed_layout)
+    flash_packed: bool = True
 
 
 def matmul_cast(ctx: OpContext, *arrays):

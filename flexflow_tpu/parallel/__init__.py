@@ -14,6 +14,7 @@ from .ops import (
     ReductionParams,
     RepartitionParams,
     ReplicateParams,
+    allgather_matmul,
     apply_parallel_op_shape,
 )
 from .strategies import (

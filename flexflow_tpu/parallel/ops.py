@@ -196,6 +196,92 @@ for _ot in (
     )
 
 
+# ------------------------------------------------- decomposed collective matmul
+# The async/overlapped twin of the tp all_gather→matmul pairs GSPMD inserts
+# when a feature-sharded activation feeds an op expecting the full feature
+# dim (tp_col after a feat/sp producer, the attention O-projection after a
+# head-sharded core). Instead of one blocking all_gather followed by one
+# big matmul, the gather is DECOMPOSED into n−1 neighbor hops each
+# overlapped with the partial matmul of the block already resident (Wang
+# et al., ASPLOS '23 — the same double-buffered ppermute schedule as
+# parallel/ring_attention.py): while x's block k rotates to the neighbor,
+# the local MXU contracts block k against the matching rows of w. Exact:
+# after n steps every shard has accumulated Σ_src x_src @ w[src rows] =
+# (all_gather(x) @ w), with the collective entirely hidden behind compute
+# when the per-block matmul dominates the hop (the long-seq regime).
+
+
+def _ag_matmul_local(x_blk, w, *, axis_name: str, n: int, overlap: bool):
+    """Per-shard body: x_blk (..., k/n) is this shard's block of the
+    contraction dim; w (k, m) holds all rows locally. Rotate x blocks
+    around the ring, contracting each against its source's row slice."""
+    import jax
+    import jax.numpy as jnp
+
+    idx = jax.lax.axis_index(axis_name)
+    k_loc = x_blk.shape[-1]
+    acc = jnp.zeros(x_blk.shape[:-1] + (w.shape[-1],), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        x_nxt = None
+        if overlap and step < n - 1:
+            # hop for block step+1 issued BEFORE the matmul of block step
+            x_nxt = jax.lax.ppermute(x_blk, axis_name, perm)
+        # the block held at `step` originated on shard (idx - step) mod n;
+        # contract it against that shard's rows of w
+        src = jax.lax.rem(idx - step + n, n)
+        w_rows = jax.lax.dynamic_slice_in_dim(w, src * k_loc, k_loc, axis=0)
+        acc = acc + jnp.dot(x_blk, w_rows.astype(x_blk.dtype),
+                            preferred_element_type=jnp.float32)
+        if step < n - 1:
+            if not overlap:
+                x_nxt = jax.lax.ppermute(x_blk, axis_name, perm)
+            x_blk = x_nxt
+    return acc.astype(x_blk.dtype)
+
+
+def allgather_matmul(x, w, *, mesh=None, axis_name: str | None = None,
+                     batch_axis: str | None = None, overlap: bool = True):
+    """Decomposed all_gather→matmul: `x` (..., k) with its last dim sharded
+    over `axis_name`, `w` (k, m) replicated along that axis; returns the
+    full x @ w (replicated over `axis_name`, batch sharding preserved) —
+    numerically the gathered matmul, scheduled as n overlapped
+    block-matmul + ppermute steps. Falls back to a plain dot when there is
+    no mesh / the axis has size 1. `overlap=False` is the serial ablation
+    baseline (hop after each block's matmul)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..machine import AXIS_DATA, AXIS_MODEL
+    from .smap import shard_map
+
+    axis_name = axis_name or AXIS_MODEL
+    batch_axis = batch_axis or AXIS_DATA
+    if mesh is None or mesh.shape.get(axis_name, 1) == 1:
+        return jnp.dot(x, w.astype(x.dtype),
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+    n = mesh.shape[axis_name]
+    if x.shape[-1] % n != 0:
+        raise ValueError(
+            f"allgather_matmul: contraction dim {x.shape[-1]} not "
+            f"divisible by axis {axis_name!r} size {n}")
+    import functools
+
+    nd = x.ndim
+    b_entry = batch_axis if mesh.shape.get(batch_axis, 1) > 1 else None
+    xspec = P(b_entry, *([None] * (nd - 2)), axis_name)
+    ospec = P(b_entry, *([None] * (nd - 1)))
+    fn = shard_map(
+        functools.partial(_ag_matmul_local, axis_name=axis_name, n=n,
+                          overlap=overlap),
+        mesh=mesh,
+        in_specs=(xspec, P(None, None)),
+        out_specs=ospec,
+        check_vma=False,
+    )
+    return fn(x, w)
+
+
 def derive_parallel_assignment(op_type: OT, params, in_assignment, mesh):
     """Mesh-axis assignment for an explicit parallel-op node's output, derived
     from its input's assignment (the runtime half of the op: the executor pins

@@ -33,8 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..machine import AXIS_DATA, AXIS_PIPE
-
-shard_map = jax.shard_map
+from .smap import shard_map
 
 
 def _sequential(stacked, x, block_fn):

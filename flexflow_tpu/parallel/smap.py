@@ -1,0 +1,23 @@
+"""shard_map compatibility shim.
+
+`jax.shard_map` (with the `check_vma` kwarg) is the current spelling;
+older jax (the pinned test container's 0.4.x) only ships
+`jax.experimental.shard_map.shard_map` with the same semantics under the
+`check_rep` kwarg. Every shard_map user in this package routes through
+this wrapper so the ring-attention / pipeline suites run on both — the
+per-shard bodies are identical either way.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)

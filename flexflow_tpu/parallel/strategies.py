@@ -327,12 +327,27 @@ def sequence_parallel_attention(model, seq_axis: str = AXIS_SEQ) -> Strategy:
     parallel/ring_attention.py) — set via FFModel.multihead_attention(impl=
     "ring") — so KV blocks rotate through the ring while queries stay
     resident. This is the long-context capability the reference lacks
-    (SURVEY §5)."""
+    (SURVEY §5).
+
+    Tensors whose seq dim does not divide by the configured seq-axis
+    degree are left alone (they would fail Strategy.validate / GSPMD
+    lowering); with no mesh information on `model` every 3D output is
+    sharded, matching the historical behavior."""
+    seq_deg = 0
+    cfg = getattr(model, "config", None)
+    if cfg is not None:
+        try:
+            ms = cfg.mesh_shape()
+            seq_deg = dict(zip(ms.axis_names, ms.axis_sizes)).get(seq_axis, 0)
+        except Exception:
+            seq_deg = 0
     s = Strategy()
     layers = getattr(model, "layers", model)
     for l in layers:
         for i, t in enumerate(l.outputs):
             if len(t.dims) == 3:
+                if seq_deg > 1 and int(t.dims[1]) % seq_deg != 0:
+                    continue  # indivisible seq dim: keep the default
                 # (batch, seq, hidden): batch over data, seq over seq axis
                 s.set_output(l.name, i, ((AXIS_DATA,), (seq_axis,), ()))
     return s

@@ -243,7 +243,17 @@ class _MakespanAccum:
     config.h:search_overlap_backward_update): gradient-allreduce time
     (passed via `sync=`) then overlaps other nodes' compute instead of
     serializing on its own node's critical path — it still occupies its ICI
-    axis, so the per-axis link-occupancy bound keeps it honest."""
+    axis, so the per-axis link-occupancy bound keeps it honest.
+
+    `overlappable_comm` is the round-7 channel for ops whose OWN collective
+    runs concurrently with their own compute (ring attention's
+    double-buffered ppermute pipeline, the decomposed collective matmul):
+    the node's critical-path contribution becomes
+    max(compute, overlappable_comm) + overlap_overhead instead of
+    compute + comm — the roofline of a perfectly pipelined schedule, plus
+    the fixed per-hop issue cost that never hides. The overlapped traffic
+    still occupies its ICI axis, so the per-axis link-occupancy bound in
+    `makespan` keeps concurrent same-axis collectives honest."""
 
     def __init__(self, overlap_sync: bool = False):
         self.compute: list[float] = []
@@ -253,16 +263,24 @@ class _MakespanAccum:
         self._axis_ids: dict[str, int] = {}
         self.overlap_sync = overlap_sync
         self._sync_by_axis: dict[int, float] = {}
+        self._overlap_by_axis: dict[int, float] = {}
 
     def add(self, guid: int, compute: float, comm: float, comm_axes=(),
-            sync: float = 0.0):
+            sync: float = 0.0, overlappable_comm: float = 0.0,
+            overlap_overhead: float = 0.0):
         self.idx[guid] = len(self.compute)
-        self.compute.append(compute)
         ax = -1
         for name in comm_axes:
             ax = self._axis_ids.setdefault(name, len(self._axis_ids))
             break  # attribute to the first (dominant) axis
         self.axis.append(ax)
+        if overlappable_comm > 0.0:
+            # overlap-capable op: comm hides behind (or extends past) the
+            # op's own compute; only the fixed issue overhead serializes
+            self._overlap_by_axis[ax] = (
+                self._overlap_by_axis.get(ax, 0.0) + overlappable_comm)
+            compute = max(compute, overlappable_comm) + overlap_overhead
+        self.compute.append(compute)
         if self.overlap_sync and sync > 0.0:
             self._sync_by_axis[ax] = self._sync_by_axis.get(ax, 0.0) + sync
             self.comm.append(comm)
@@ -281,13 +299,25 @@ class _MakespanAccum:
             return 0.0
         out = graph_makespan(self.compute, self.comm, src, dst,
                              axis=self.axis)
-        if self._sync_by_axis:
-            # overlapped gradient sync: bounded by per-axis link occupancy
-            # (path comm on the same axis shares the links)
+        if self._sync_by_axis or self._overlap_by_axis:
+            # per-axis link occupancy including the OVERLAPPED traffic:
+            # hiding comm behind compute does not add link capacity, so
+            # same-axis serial + overlapped + sync bytes still serialize
+            # against each other
             per_axis_comm: dict[int, float] = {}
             for ax, c in zip(self.axis, self.comm):
                 if ax >= 0:
                     per_axis_comm[ax] = per_axis_comm.get(ax, 0.0) + c
+            for ax, c in self._overlap_by_axis.items():
+                if ax >= 0:
+                    per_axis_comm[ax] = per_axis_comm.get(ax, 0.0) + c
+            if self._overlap_by_axis:
+                # the plain per-axis occupancy bound only exists to keep
+                # OVERLAPPED bytes honest; sync-only plans keep the
+                # pre-overlap pricing (and diagnostics/explain.py
+                # verify_report_total applies the same gate)
+                for ax, c in per_axis_comm.items():
+                    out = max(out, c)
             for ax, s in self._sync_by_axis.items():
                 out = max(out, s + per_axis_comm.get(ax, 0.0))
         return out
@@ -579,6 +609,106 @@ class CostModel:
             "candidates": len(candidates),
         }
         return measured
+
+    # ------------------------------------------- collective calibration
+    # The ring/pipeline schedules are priced per ppermute hop; the analytic
+    # machine model guesses that hop from datasheet ICI bandwidth. Like the
+    # op measurements above, the real hop is measurable: a jitted
+    # shard_map fori_loop of chained ppermutes, timed at two trip counts
+    # (slope = true per-hop seconds, constants cancelled) and at two
+    # payload sizes (slope over bytes = effective 1/bandwidth, intercept =
+    # per-hop launch latency). Entries live in the same `_calibration`
+    # dict under a reserved OP_NOOP key, so the warm-start calibration DB
+    # persists them per device kind for free.
+
+    _HOP_BYTES = (1 << 16, 1 << 22)  # 64 KiB / 4 MiB per-chip payloads
+
+    def _collective_key(self, axis: str):
+        return (OT.OP_NOOP, f"__collective_ppermute__:{axis}",
+                ((self._HOP_BYTES[0],), (self._HOP_BYTES[1],)))
+
+    def collective_rotate(self, bytes_per_chip: float, axis: str) -> float:
+        """One ring-rotation hop for `bytes_per_chip`: the calibrated
+        two-point fit when a measurement exists, else the machine model's
+        analytic `rotate`."""
+        cal = self._calibration.get(self._collective_key(axis))
+        if cal is None:
+            return self.machine.rotate(bytes_per_chip, axis)
+        t_small, t_big = cal
+        b0, b1 = self._HOP_BYTES
+        slope = max((t_big - t_small) / (b1 - b0), 0.0)
+        lat = max(t_small - slope * b0, 0.0)
+        return lat + bytes_per_chip * slope
+
+    def calibrate_collectives(self, mesh, axes) -> int:
+        """Measure the ppermute hop on each of `axes` (mesh axes of size
+        > 1) and pin it for `collective_rotate`. Cached entries (including
+        warm-start DB loads) are kept; harness failures leave the analytic
+        model in place. Returns the number of axes measured."""
+        measured = 0
+        for axis in axes:
+            key = self._collective_key(axis)
+            if key in self._calibration:
+                continue
+            try:
+                ts = tuple(self._measure_hop(mesh, axis, nb)
+                           for nb in self._HOP_BYTES)
+            except Exception:
+                continue
+            self._calibration[key] = ts
+            measured += 1
+        if measured:
+            self._cache.clear()
+        return measured
+
+    def _measure_hop(self, mesh, axis: str, nbytes: int) -> float:
+        """Median per-hop seconds of a chained-ppermute loop at the given
+        per-chip payload (two trip counts; the slope cancels dispatch and
+        sync constants — the same relay-immune methodology as
+        `calibrate`)."""
+        import statistics
+        import time
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.smap import shard_map
+
+        n = dict(mesh.shape).get(axis, 1)
+        if n <= 1:
+            raise ValueError(f"axis {axis!r} has size {n}")
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        elems = max(128, nbytes // 4)
+        x = jnp.zeros((n * elems,), jnp.float32)
+        spec = P(axis)
+
+        def local(xs, reps):
+            def body(_, carry):
+                return jax.lax.ppermute(carry, axis, perm)
+
+            return jax.lax.fori_loop(0, reps, body, xs)
+
+        inner = shard_map(local, mesh=mesh, in_specs=(spec, P()),
+                          out_specs=spec, check_vma=False)
+
+        @jax.jit
+        def run(xs, reps):
+            return jnp.sum(inner(xs, reps))
+
+        n1, n2 = 8, 40
+        float(jax.device_get(run(x, jnp.int32(n1))))  # compile + warm
+
+        def t_of(reps):
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(jax.device_get(run(x, jnp.int32(reps))))
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts)
+
+        dt = (t_of(n2) - t_of(n1)) / (n2 - n1)
+        return max(dt, 1e-9)
 
 
 _NON_COMPUTE = frozenset({

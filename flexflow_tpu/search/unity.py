@@ -406,24 +406,38 @@ class UnitySearch:
                     cfg.out_assign, self.axis_sizes) * dtype_bytes(out_pt.dtype)
                 psum += self.cm.machine.all_reduce(shard_bytes, ax)
             comm_axes = tuple(cfg.psum_axes)
+            overlap_comm = 0.0
+            overlap_overhead = 0.0
             if (cfg.name == "sp"
                     and node.op_type == OT.OP_MULTIHEAD_ATTENTION):
                 # ring attention's defining cost: K and V blocks rotate
                 # (seq_deg − 1) neighbor hops per forward, and the backward
                 # re-rotates them (≈2× fwd) — priced as ppermute traffic of
-                # the local activation block (parallel/ring_attention.py)
+                # the local activation block (parallel/ring_attention.py).
+                # rotate, not ppermute: the K/V shift includes the wrap
+                # pair, which a non-wraparound (open) seq axis pays as a
+                # full line traversal (TorusMachineModel.rotate); the
+                # calibrated hop (collective_rotate) overrides the analytic
+                # guess when the warm-start DB carries a measurement.
                 out_pt = node.outputs[0]
                 local_bytes = _shard_elems(
                     tuple(d.size for d in out_pt.shape.dims
                           if not d.is_replica_dim),
                     cfg.out_assign, self.axis_sizes) * dtype_bytes(out_pt.dtype)
                 hops = 2 * (self.seq_deg - 1)  # K and V, fwd
-                # rotate, not ppermute: the K/V shift includes the wrap
-                # pair, which a non-wraparound (open) seq axis pays as a
-                # full line traversal (TorusMachineModel.rotate)
-                psum += 3.0 * hops * self.cm.machine.rotate(
+                ring_comm = 3.0 * hops * self.cm.collective_rotate(
                     local_bytes, AXIS_SEQ)
                 comm_axes = comm_axes + (AXIS_SEQ,)
+                if getattr(self.config, "overlap_collectives", True):
+                    # the runtime issues each hop before the block compute
+                    # it overlaps (double-buffered ppermute pipeline), so
+                    # the honest price is max(compute, comm) plus the
+                    # fixed per-hop issue latency that never hides
+                    overlap_comm = ring_comm
+                    overlap_overhead = (
+                        3.0 * hops * self.cm.machine._lat(AXIS_SEQ))
+                else:
+                    psum += ring_comm
             compute_t = cm.forward_time + cm.backward_time
             if (cfg.name == "pp"
                     and node.op_type == OT.OP_PIPE_BLOCKS):
@@ -456,7 +470,9 @@ class UnitySearch:
             acc.add(node.guid,
                     compute_t,
                     cm.comm_time + reshard + psum,
-                    comm_axes=comm_axes, sync=cm.sync_time)
+                    comm_axes=comm_axes, sync=cm.sync_time,
+                    overlappable_comm=overlap_comm,
+                    overlap_overhead=overlap_overhead)
             mem += cm.memory
             if collect is not None:
                 # compute_t may carry the pipeline bubble stretch; report
@@ -471,6 +487,10 @@ class UnitySearch:
                     "sync_s": cm.sync_time,
                     "reshard_s": reshard,
                     "collective_s": cm.comm_time + psum,
+                    # overlap-capable collective traffic (hidden behind
+                    # this op's compute; still occupies its ICI axis)
+                    "overlap_s": overlap_comm,
+                    "overlap_overhead_s": overlap_overhead,
                     "memory_bytes": cm.memory,
                     "comm_axes": list(comm_axes)})
         if collect is not None:

@@ -44,6 +44,10 @@ _SEARCH_CONFIG_FIELDS = (
     "base_optimize_threshold", "perform_memory_search",
     "search_num_nodes", "search_num_workers",
     "num_nodes", "workers_per_node",
+    # overlap-capable collectives price as max(compute, comm) instead of
+    # compute + comm (search/cost_model.py) — toggling it can flip the
+    # winning strategy, so plans must not share an address across it
+    "overlap_collectives",
     "computation_dtype", "allow_tensor_op_math_conversion",
     "force_tensor_op_math",
     # serving (serving/): a decode graph compiles under
@@ -160,6 +164,14 @@ def calibration_fingerprint(cost_model, graph) -> str:
         seen.add(key)
         cal = cost_model._calibration.get(key)
         if cal is not None:
+            entries.append([serialize_key(key), repr(cal[0]), repr(cal[1])])
+    # collective-hop entries (reserved OP_NOOP keys written by
+    # CostModel.calibrate_collectives): they price the sp ring traffic
+    # via collective_rotate, so a refreshed hop measurement must change
+    # the plan address like any other calibration the search consumed
+    for key, cal in cost_model._calibration.items():
+        name = key[1] if len(key) > 1 else ""
+        if isinstance(name, str) and name.startswith("__collective_"):
             entries.append([serialize_key(key), repr(cal[0]), repr(cal[1])])
     entries.sort()
     return _sha({"v": 1, "calibration": entries})

@@ -212,9 +212,11 @@ def test_two_tower_costed_as_makespan_not_sum():
     orig = _MakespanAccum.add
 
     class Spy(_MakespanAccum):
-        def add(self, guid, compute, comm, comm_axes=(), sync=0.0):
+        def add(self, guid, compute, comm, comm_axes=(), sync=0.0,
+                **kwargs):
             rows.append((guid, compute, comm + sync))
-            orig(self, guid, compute, comm, comm_axes=comm_axes, sync=sync)
+            orig(self, guid, compute, comm, comm_axes=comm_axes, sync=sync,
+                 **kwargs)
 
     import flexflow_tpu.search.unity as unity_mod
     saved = unity_mod._MakespanAccum
@@ -457,3 +459,162 @@ def test_sequence_parallel_config_in_search():
         choice[n.guid] = sp[0] if sp else cfgs[0]
     t_sp, _ = s.evaluate(choice)
     assert t_sp > 0
+
+
+def test_overlappable_comm_prices_as_max():
+    """An overlap-capable op's collective prices as max(compute, comm) +
+    fixed overhead in the makespan — not compute + comm — while still
+    occupying its ICI axis for the link-occupancy bound."""
+    from flexflow_tpu.search.cost_model import _MakespanAccum
+
+    edges = {1: [], 2: []}
+
+    # comm-bound op: comm 2.0 hides the 1.0 compute → 2.0 + 0.1 overhead
+    acc = _MakespanAccum()
+    acc.add(1, 1.0, 0.0, comm_axes=("seq",), overlappable_comm=2.0,
+            overlap_overhead=0.1)
+    assert np.isclose(acc.makespan(edges), 2.1)
+
+    # compute-bound op: compute 3.0 hides the 2.0 comm → 3.0 + 0.1
+    acc = _MakespanAccum()
+    acc.add(1, 3.0, 0.0, comm_axes=("seq",), overlappable_comm=2.0,
+            overlap_overhead=0.1)
+    assert np.isclose(acc.makespan(edges), 3.1)
+
+    # the serial twin of the first case pays compute + comm
+    acc = _MakespanAccum()
+    acc.add(1, 1.0, 2.0, comm_axes=("seq",))
+    assert np.isclose(acc.makespan(edges), 3.0)
+
+    # per-axis occupancy: overlapped traffic still serializes against
+    # OTHER comm on the same axis — two overlapped ops on one axis are
+    # bounded by their combined link time even when each hides behind
+    # its own (parallel-branch) compute
+    acc = _MakespanAccum()
+    acc.add(1, 1.0, 0.0, comm_axes=("seq",), overlappable_comm=4.0)
+    acc.add(2, 1.0, 0.0, comm_axes=("seq",), overlappable_comm=4.0)
+    assert acc.makespan(edges) >= 8.0
+
+
+def test_overlap_pricing_flips_search_to_ring_sp():
+    """The acceptance scenario for the overlap-aware cost model: a
+    long-seq graph + an ICI bandwidth where the ring's communication is
+    ~74% of the dp attention compute. Serial pricing (compute + comm)
+    rejects the sequence-parallel ring strategy — the hops land ON TOP of
+    the (4× smaller) sharded compute, pushing past the dp price — while
+    overlap pricing (max(compute, comm), matching the double-buffered
+    runtime schedule) selects it. Same graph, same machine, same
+    measurements: only the pricing rule differs."""
+    sys.argv = ["test"]
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.fftype import OperatorType as OT
+    from flexflow_tpu.search import CostModel, UnitySearch
+    from flexflow_tpu.search.cost_model import _shard_elems, dtype_bytes
+    from flexflow_tpu.search.machine_model import CHIPS, TPUMachineModel
+    from dataclasses import replace
+
+    config = FFConfig()
+    config.mesh_axis_sizes = (1, 1, 1, 4)  # seq=4 (long-context: batch 1)
+    config.batch_size = 1
+    config.enable_sample_parallel = True
+    ff = FFModel(config)
+    x = ff.create_tensor((1, 4096, 64), name="x")
+    a = ff.multihead_attention(x, x, x, 64, 4, causal=True, impl="ring",
+                               name="rattn")
+    t = ff.layer_norm(a, [2], name="ln")
+    ff.dense(t, 8, name="head")
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    n_seq = 4
+    axis_sizes = {k: int(v) for k, v in ff.mesh.shape.items()}
+
+    def search_for(machine, overlap: bool):
+        config.overlap_collectives = overlap
+        return UnitySearch(ff.graph, ff.mesh, config, CostModel(machine))
+
+    # 1) price the dp attention (fwd+bwd) on a probe machine — ICI
+    #    bandwidth does not enter op_cost, so this is the real C_dp
+    probe = TPUMachineModel(CHIPS["v5e"], axis_sizes)
+    s_probe = search_for(probe, True)
+    attn = next(n for n in s_probe.order
+                if n.op_type == OT.OP_MULTIHEAD_ATTENTION)
+    dp_cfg = next(c for c in s_probe.node_configs(attn) if c.name == "dp")
+    in_shapes = [tuple(d.size for d in pt.shape.dims
+                       if not d.is_replica_dim) for pt in attn.inputs]
+    cmx = s_probe.cm.op_cost(
+        attn, [dp_cfg.out_assign], dict(dp_cfg.weight_specs),
+        in_shapes, [dp_cfg.out_assign] * len(in_shapes))
+    c_dp = cmx.forward_time + cmx.backward_time
+
+    # 2) solve the ICI bandwidth that puts the ring comm at 0.85·C_dp:
+    #    ring = 3 · 2(n−1) · (local_bytes/bw + lat)
+    out_pt = attn.outputs[0]
+    shape = tuple(d.size for d in out_pt.shape.dims if not d.is_replica_dim)
+    sp_cfg = next(c for c in s_probe.node_configs(attn) if c.name == "sp")
+    local_bytes = _shard_elems(shape, sp_cfg.out_assign, axis_sizes) \
+        * dtype_bytes(out_pt.dtype)
+    hops = 3.0 * 2 * (n_seq - 1)
+    lat = 1e-7
+    per_hop_target = 0.74 * c_dp / hops
+    assert per_hop_target > lat
+    bw = local_bytes / (per_hop_target - lat)
+    chip = replace(CHIPS["v5e"], ici_bandwidth=bw, ici_latency=lat)
+    machine = TPUMachineModel(chip, axis_sizes)
+
+    def cost_of(s, want):
+        choice = {}
+        for n in s.order:
+            cfgs = s.node_configs(n)
+            if not cfgs:
+                continue
+            named = [c for c in cfgs if c.name == want]
+            choice[n.guid] = named[0] if named else cfgs[0]
+        t, mem = s.evaluate(choice)
+        return s._memory_penalized(t, mem)
+
+    # serial pricing: the ring strategy LOSES to dp...
+    s_serial = search_for(machine, overlap=False)
+    assert cost_of(s_serial, "sp") > cost_of(s_serial, "dp")
+    best_serial = s_serial.run()
+    assert best_serial[attn.guid].name != "sp"
+
+    # ...overlap pricing: the SAME strategy on the SAME machine wins,
+    # and the search selects it
+    s_overlap = search_for(machine, overlap=True)
+    assert cost_of(s_overlap, "sp") < cost_of(s_overlap, "dp")
+    best_overlap = s_overlap.run()
+    assert best_overlap[attn.guid].name == "sp"
+    config.overlap_collectives = True
+
+
+def test_ppermute_hop_calibration_roundtrip(tmp_path):
+    """calibrate_collectives measures the real ppermute hop on the mesh
+    (two payloads, two-point slope), collective_rotate serves the fitted
+    hop, and the entry persists per device kind through the warm-start
+    calibration DB like any op measurement."""
+    from flexflow_tpu.machine import MeshShape, build_mesh
+    from flexflow_tpu.search import CostModel
+    from flexflow_tpu.search.machine_model import machine_model_for_mesh
+    from flexflow_tpu.warmstart.calibration_db import CalibrationDB
+
+    mesh = build_mesh(MeshShape((1, 1, 4, 1),
+                                ("data", "model", "seq", "pipe")))
+    cm = CostModel(machine_model_for_mesh(mesh))
+    analytic = cm.collective_rotate(262144, "seq")
+    assert analytic == cm.machine.rotate(262144, "seq")  # no measurement yet
+    assert cm.calibrate_collectives(mesh, ["seq"]) == 1
+    measured = cm.collective_rotate(262144, "seq")
+    assert measured > 0
+    # monotone in bytes, with a non-negative intercept
+    assert cm.collective_rotate(2 * 262144, "seq") >= measured
+    # size-1 axes are not measurable — left analytic, not crashed
+    assert cm.calibrate_collectives(mesh, ["model"]) == 0
+
+    db = CalibrationDB(str(tmp_path))
+    db.save_from(cm)
+    cm2 = CostModel(machine_model_for_mesh(mesh))
+    db.load_into(cm2)
+    assert cm2.collective_rotate(262144, "seq") == pytest.approx(measured)
+    # a warm DB re-calibrates nothing (the cached entry wins)
+    assert cm2.calibrate_collectives(mesh, ["seq"]) == 0
