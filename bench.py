@@ -38,13 +38,34 @@ def _peak_flops(device) -> float:
     return 2e12  # CPU fallback so the harness still runs
 
 
+def _hbm_stats(device) -> dict:
+    """{peak_hbm_bytes, hbm_bytes_in_use} from the backend allocator, or
+    {} when the platform has no memory_stats (XLA:CPU)."""
+    try:
+        stats = device.memory_stats()
+    except Exception:  # pragma: no cover - platform-dependent
+        stats = None
+    if not stats:
+        return {}
+    out = {}
+    if stats.get("peak_bytes_in_use") is not None:
+        out["peak_hbm_bytes"] = int(stats["peak_bytes_in_use"])
+    if stats.get("bytes_in_use") is not None:
+        out["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
+    return out
+
+
 def _measure_lm(cfg, batch: int, steps: int, warmup: int, on_tpu: bool,
-                tune=None):
+                tune=None, out: dict = None):
     """(tokens/s, MFU) of one LM training config, or (None, None) when
     every retry reads as a backend fluke (>100% MFU). `tune(config)`, when
     given, mutates the FFConfig before the model is built — the ablation
     legs use it to flip kernel layout / collective-overlap / mesh knobs
-    against an otherwise identical measurement."""
+    against an otherwise identical measurement. `out`, when a dict, is
+    filled with the leg's memory forensics: allocator stats after warmup
+    (resident state incl. masters + optimizer slots — the reading the
+    weight-update-sharding ablation compares) and the compile's
+    update-sharding decision."""
     import jax
 
     from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
@@ -116,6 +137,17 @@ def _measure_lm(cfg, batch: int, steps: int, warmup: int, on_tpu: bool,
     with telemetry.span("bench.warmup", steps=warmup):
         st, rng = loop(state, rng, batch_data, jnp.int32(warmup))
         sync(st)  # compile + warm
+
+    if out is not None:
+        out.update(_hbm_stats(jax.devices()[0]))
+        upd = getattr(ff, "_update_sharding", None) or {}
+        out["update_sharding"] = bool(upd.get("enabled"))
+        out["update_shards"] = int(upd.get("shards", 1))
+        pred = upd.get("predicted") or {}
+        if pred:
+            out["predicted_mem_bytes_per_chip"] = (
+                pred["sharded_mem_bytes"] if upd.get("enabled")
+                else pred["replicated_mem_bytes"])
 
     def t_of(n, st, rng):
         ts = []
@@ -285,6 +317,120 @@ def _attention_ablation_legs(lcfg, batch: int, steps: int, warmup: int,
         legs["ring_overlap_tokens_per_sec"] = None
         legs["ring_serial_tokens_per_sec"] = None
     return legs
+
+
+def _grad_sync_legs(cfg, batch: int, steps: int, warmup: int,
+                    on_tpu: bool) -> dict:
+    """Weight-update-sharding ablation (round 8, docs/performance.md
+    "Weight-update sharding"): the same LM on a pure-dp mesh over all
+    local devices, measured three ways —
+
+    - replicated: the baseline serial gradient allreduce + every replica
+      redundantly holding fp32 masters + optimizer slots and running the
+      full update (--no-weight-update-sharding);
+    - sharded_overlap: ZeRO-style 1/dp update with the grad reduce-scatter
+      free to overlap backward compute and the updated-param all-gather
+      deferred into each consumer's first use (--weight-update-sharding);
+    - sharded_serial: same 1/dp state, overlap pricing/schedule off
+      (--no-overlap-collectives) — isolates the overlap contribution from
+      the memory win.
+
+    Each leg records the allocator's resident bytes after warmup (masters
+    + slots live there — the 1/dp saving shows up directly) next to its
+    tokens/s. Also includes a ring_reduce_scatter microbench: the
+    free-scheduled ppermute pipeline vs the barrier-forced serial
+    hop-then-add ablation on a gradient-sized buffer — the schedule the
+    sharded grad sync lowers to, measured in isolation."""
+    import jax
+
+    n = min(jax.local_device_count(), batch)
+    legs = {"update_shards": n}
+    if n <= 1:
+        legs["skipped"] = "single device — no grad sync to shard"
+        return legs
+
+    def dp_tune(wus, overlap=True):
+        def tune(c):
+            c.mesh_axis_sizes = (n, 1, 1, 1)
+            c.weight_update_sharding = wus
+            c.overlap_collectives = overlap
+
+        return tune
+
+    for name, wus, overlap in (("replicated", False, True),
+                               ("sharded_overlap", True, True),
+                               ("sharded_serial", True, False)):
+        mem: dict = {}
+        tps, _ = _measure_lm(cfg, batch, steps, warmup, on_tpu,
+                             tune=dp_tune(wus, overlap), out=mem)
+        legs[f"{name}_tokens_per_sec"] = (
+            None if tps is None else round(tps, 2))
+        if "hbm_bytes_in_use" in mem:
+            legs[f"{name}_hbm_bytes_in_use"] = mem["hbm_bytes_in_use"]
+        if "predicted_mem_bytes_per_chip" in mem:
+            legs[f"{name}_predicted_mem_bytes_per_chip"] = round(
+                mem["predicted_mem_bytes_per_chip"])
+    so, rep = (legs.get("sharded_overlap_tokens_per_sec"),
+               legs.get("replicated_tokens_per_sec"))
+    ss = legs.get("sharded_serial_tokens_per_sec")
+    if so and rep:
+        legs["sharded_overlap_vs_replicated"] = round(so / rep, 4)
+    if so and ss:
+        legs["overlap_vs_serial"] = round(so / ss, 4)
+
+    try:
+        legs["rs_microbench"] = _ring_rs_microbench(n)
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"bench: ring-RS microbench failed: {e}", file=sys.stderr)
+    return legs
+
+
+def _ring_rs_microbench(n: int, rows: int = 4096, cols: int = 512,
+                        iters: int = 8) -> dict:
+    """Seconds per reduce-scatter of a (rows, cols) fp32 buffer over a
+    dp=n mesh: the free-scheduled ppermute pipeline
+    (parallel.ops.ring_reduce_scatter — each hop independent of the
+    local chunk add beside it) vs the serial ablation whose
+    optimization barrier forces every add to wait for its hop. Two-point
+    slope over a jitted fori_loop, like every other bench leg."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.machine import MeshShape, build_mesh
+    from flexflow_tpu.parallel.ops import ring_reduce_scatter
+
+    rows -= rows % (n * n)
+    mesh = build_mesh(MeshShape((n, 1, 1, 1)))
+    x = jnp.arange(rows * cols, dtype=jnp.float32).reshape(rows, cols)
+    out = {}
+    for name, overlap in (("overlap", True), ("serial", False)):
+        rs = functools.partial(ring_reduce_scatter, mesh=mesh,
+                               axis_name="data", overlap=overlap)
+
+        @jax.jit
+        def loop(x0, m):
+            def body(_, acc):
+                # rescale so the collective (not the arithmetic) dominates
+                # and the loop-carried value stays finite
+                return jnp.tile(rs(acc) * 1e-3, (n, 1))
+
+            return jax.lax.fori_loop(0, m, body, x0)
+
+        jax.block_until_ready(loop(x, jnp.int32(iters)))  # compile + warm
+        t1 = time.perf_counter()
+        jax.block_until_ready(loop(x, jnp.int32(iters)))
+        t1 = time.perf_counter() - t1
+        t2 = time.perf_counter()
+        jax.block_until_ready(loop(x, jnp.int32(3 * iters)))
+        t2 = time.perf_counter() - t2
+        out[f"{name}_s"] = max((t2 - t1) / (2 * iters), 0.0)
+    if out.get("serial_s"):
+        out["overlap_vs_serial"] = round(
+            out["serial_s"] / out["overlap_s"], 4) if out["overlap_s"] else None
+    out["bytes"] = rows * cols * 4
+    return out
 
 
 def _warmstart_legs() -> dict:
@@ -476,7 +622,9 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
         batch = 4
         steps, warmup = 5, 1
 
-    tokens_per_sec, mfu = _measure_lm(cfg, batch, steps, warmup, on_tpu)
+    primary_mem: dict = {}
+    tokens_per_sec, mfu = _measure_lm(cfg, batch, steps, warmup, on_tpu,
+                                      out=primary_mem)
 
     seq4096 = None
     if on_tpu and tokens_per_sec is not None:
@@ -540,6 +688,19 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
     except Exception as e:  # pragma: no cover - defensive
         print(f"bench: fit-loop leg failed: {e}", file=sys.stderr)
 
+    # grad-sync ablation legs (round 8): replicated allreduce vs ZeRO-
+    # sharded update with/without overlap, with per-leg resident HBM so
+    # the 1/dp optimizer-state saving lands next to tokens/s/chip
+    grad_sync = None
+    try:
+        grad_sync = _grad_sync_legs(cfg, batch, steps, warmup, on_tpu)
+        print(json.dumps({
+            "metric": "grad_sync_ablation",
+            **{k: v for k, v in grad_sync.items() if k != "rs_microbench"},
+        }))
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"bench: grad-sync ablation failed: {e}", file=sys.stderr)
+
     # serving leg: requests/s/chip + decode tokens/s/chip through the
     # continuous-batching engine, as secondary lines + a `serving` field
     # in the primary payload
@@ -581,11 +742,18 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
         "value": None if tokens_per_sec is None else round(tokens_per_sec, 2),
         "unit": "tokens/s",
         "vs_baseline": None if tokens_per_sec is None else round(mfu / 0.35, 4),
+        # allocator peak of the primary leg (null where the backend has no
+        # memory_stats, e.g. XLA:CPU): the reading the 1/dp optimizer-
+        # state saving moves — compare against grad_sync's per-leg
+        # resident bytes
+        "peak_hbm_bytes_per_chip": primary_mem.get("peak_hbm_bytes"),
     }
     if seq4096 is not None:
         payload["seq4096"] = seq4096
     if fit_loop is not None:
         payload["fit_loop"] = fit_loop
+    if grad_sync is not None:
+        payload["grad_sync"] = grad_sync
     if serving is not None:
         payload["serving"] = serving
     if warmstart is not None:

@@ -74,6 +74,16 @@ class FFConfig:
     # ablation baseline (bench.py's seq-4096 kernel legs, PERF.md's
     # ~0.8 ms/step copies)
     flash_packed_layout: bool = True
+    # weight-update sharding (ZeRO / Xu et al. 2020): fp32 masters +
+    # optimizer slots sharded 1/dp along the gradient-reduction axes, the
+    # grad sync lowered as an overlappable reduce-scatter and the updated-
+    # param all-gather deferred into each consumer's first use next step.
+    # None (default) = Unity decides by pricing both updates — sharded is
+    # selected exactly when the plan is memory- or grad-sync-bound
+    # (search/unity.choose_update_sharding); True/False force it
+    # (--weight-update-sharding / --no-weight-update-sharding). Bit-
+    # identical trajectories either way (docs/performance.md).
+    weight_update_sharding: Optional[bool] = None
     # parallelism gates (reference config.h:133-137)
     only_data_parallel: bool = False
     enable_sample_parallel: bool = False
@@ -277,6 +287,10 @@ class FFConfig:
                 self.search_overlap_backward_update = True
             elif a == "--no-overlap-collectives":
                 self.overlap_collectives = False
+            elif a == "--weight-update-sharding":
+                self.weight_update_sharding = True
+            elif a == "--no-weight-update-sharding":
+                self.weight_update_sharding = False
             elif a == "--flash-transposed":
                 self.flash_packed_layout = False
             elif a == "--fusion":

@@ -168,6 +168,8 @@ def build_strategy_report(model) -> dict:
     from ..search.cost_model import CostModel
     from ..search.machine_model import machine_model_for_mesh
 
+    upd = getattr(model, "_update_sharding", None) or {"enabled": False}
+
     sr = getattr(model, "_search_result", None)
     if sr is not None:
         us, choice = sr
@@ -237,6 +239,14 @@ def build_strategy_report(model) -> dict:
         # so a second report build still labels the plan honestly.
         model._replay_search = (us, choice)
 
+    # price the update mode that actually runs (unity.choose_update_
+    # sharding's decision): sharded → the grad RS+AG rides the
+    # overlappable channel and memory carries the 1/dp state, so the
+    # drift monitor arms with the running schedule's makespan
+    us.cm.update_sharding = bool(upd.get("enabled"))
+    us.cm.overlap_update = (bool(upd.get("enabled"))
+                            and bool(model.config.overlap_collectives))
+
     detail: list[dict] = []
     makespan, mem = us.evaluate(choice, collect=detail)
     src, dst = _detail_edges(us, detail)
@@ -262,6 +272,7 @@ def build_strategy_report(model) -> dict:
             "comm_s": d["comm_s"],
             "reshard_s": d["reshard_s"], "collective_s": d["collective_s"],
             "overlap_s": d.get("overlap_s", 0.0),
+            "grad_sync_s": d.get("grad_sync_s", 0.0),
             "sync_s": d["sync_s"],
             "comm_axis_id": d["comm_axis_id"],
             "memory_bytes": d["memory_bytes"],
@@ -277,6 +288,15 @@ def build_strategy_report(model) -> dict:
         "mesh_axes": {k: int(v) for k, v in
                       getattr(model.mesh, "shape", {}).items()},
         "overlap_sync": bool(us.config.search_overlap_backward_update),
+        # weight-update sharding (ZeRO / Xu et al.): whether the running
+        # plan shards masters + optimizer slots 1/dp, how many shards,
+        # and the grad RS+AG seconds priced on the overlappable channel
+        # (each op's share is its grad_sync_s, inside its overlap_s when
+        # overlapped — the makespan identity covers it via the same
+        # per-axis occupancy bound as the ring traffic)
+        "update_sharding": bool(upd.get("enabled")),
+        "update_shards": int(upd.get("shards", 1)),
+        "grad_sync_s": 0.0,  # filled from the op entries below
         "total_predicted_s": makespan,
         "penalized_cost_s": chosen_cost,
         "peak_memory_bytes": mem,
@@ -288,6 +308,7 @@ def build_strategy_report(model) -> dict:
         "runner_ups": runner_ups,
         "runner_up_evals": flip_evals,
     }
+    report["grad_sync_s"] = float(sum(o["grad_sync_s"] for o in ops))
     return report
 
 
@@ -304,6 +325,14 @@ def render_markdown(report: dict) -> str:
         f"Σcomm {report['sum_comm_s'] * 1e3:.3f} ms)",
         f"- peak per-chip memory: "
         f"{report['peak_memory_bytes'] / 2**20:.1f} MiB",
+    ]
+    if report.get("update_sharding"):
+        lines.append(
+            f"- weight-update sharding: ON — masters + optimizer slots "
+            f"1/{report.get('update_shards', 1)} per chip, grad RS+AG "
+            f"{report.get('grad_sync_s', 0.0) * 1e3:.3f} ms on the "
+            f"overlappable channel")
+    lines += [
         "",
         "## Per-op attribution",
         "",

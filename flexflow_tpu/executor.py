@@ -53,6 +53,7 @@ class Executor:
         optimizer: Optimizer,
         logits_node: OpNode,
         label_spec: PartitionSpec,
+        update_sharding: Optional[dict] = None,
     ):
         self.graph = graph
         self.mesh = mesh
@@ -63,6 +64,22 @@ class Executor:
         self.order = graph.topo_order()
         self.logits_node = logits_node
         self.label_spec = label_spec
+        # weight-update sharding (ZeRO / Xu et al.; decided by
+        # unity.choose_update_sharding): fp32 masters + optimizer slots of
+        # each shardable trainable weight live 1/dp-sharded along its
+        # gradient-reduction axes. update_specs[(node, weight)] = (spec,
+        # shape): the at-rest PartitionSpec init_variables places with and
+        # the train step pins grads / updated params / slots to — GSPMD
+        # then lowers the grad psum into a reduce-scatter in layer order
+        # and defers the updated-param all-gather into each consumer's
+        # first use next step (it fuses with the _cast_compute downcast at
+        # that seam). The update math is element-wise on the same reduced
+        # gradient values, so the trajectory is bit-identical to the
+        # replicated update.
+        self.update_sharding = update_sharding or {"enabled": False}
+        self.update_specs: dict[tuple[str, str], tuple] = {}
+        if self.update_sharding.get("enabled"):
+            self._build_update_specs()
         # A substitution rewrite may have interposed Combine/Repartition/...
         # nodes between the real softmax and the marked logits node; walk
         # back through value-preserving parallel ops so the loss doesn't
@@ -104,6 +121,128 @@ class Executor:
         # chunked (lax.scan) train steps keyed by chunk length — the
         # pipelined engine's fused multi-step dispatch (engine/)
         self._chunk_steps: dict[int, Any] = {}
+
+    def _build_update_specs(self):
+        """Resolve the per-weight update shardings through the SAME
+        helpers the cost model prices with (parallel/ops): for every
+        trainable, non-tied weight, the gradient-reduction axes (consumer
+        activation axes minus the weight's own) extend the plan's compute
+        spec on the first divisible dim. Non-shardable weights stay
+        replicated — their update is the replicated baseline (still
+        bit-identical). Emits the weight_update telemetry event plus one
+        grad_sync bytes counter per layer-order bucket (= param-owning
+        node) so the drift monitor sees the new comm channel."""
+        from . import telemetry
+        from .parallel.ops import grad_sync_axes, weight_update_spec
+
+        axis_sizes = {k: int(v) for k, v in dict(self.mesh.shape).items()}
+        total_bytes = 0
+        buckets = 0
+        used_axes: set = set()
+        max_shards = 1
+        for node in self.order:
+            if getattr(node, "weight_source", None):
+                continue
+            out_axes = set()
+            if node.outputs:
+                for entry in node.outputs[0].partition_spec():
+                    if entry is None:
+                        continue
+                    out_axes.update(entry if isinstance(entry, tuple)
+                                    else (entry,))
+            bucket_bytes = 0
+            for ws in node.weight_specs:
+                if not ws.trainable:
+                    continue
+                base = node.weight_axes.get(ws.name, PartitionSpec())
+                w_axes = set()
+                for entry in base:
+                    if entry is None:
+                        continue
+                    w_axes.update(entry if isinstance(entry, tuple)
+                                  else (entry,))
+                axes = tuple(ax for ax in grad_sync_axes(out_axes, w_axes)
+                             if axis_sizes.get(ax, 1) > 1)
+                if not axes:
+                    continue
+                spec = weight_update_spec(ws.shape, base, axes, axis_sizes)
+                if spec is None:
+                    continue
+                self.update_specs[(node.name, ws.name)] = (
+                    spec, tuple(ws.shape))
+                used_axes.update(axes)
+                deg = 1
+                for ax in axes:
+                    deg *= axis_sizes.get(ax, 1)
+                max_shards = max(max_shards, deg)
+                nbytes = int(np.prod(ws.shape)) * 4
+                bucket_bytes += nbytes
+                total_bytes += nbytes
+            if bucket_bytes:
+                buckets += 1
+                telemetry.counter("grad_sync", {
+                    "bucket": buckets, "bytes": bucket_bytes})
+        self.update_sharding = dict(self.update_sharding,
+                                    buckets=buckets,
+                                    sharded_weights=len(self.update_specs),
+                                    bytes=total_bytes)
+        if self.update_specs:
+            # the REALIZED layout can exceed the decision's dp-default
+            # guess (a seq-sharded consumer adds `seq` to a weight's
+            # reduction axes): record what actually runs — the manifest,
+            # the weight_update event, and strategy_report all read this
+            self.update_sharding["axes"] = sorted(used_axes)
+            self.update_sharding["shards"] = max_shards
+        else:
+            # decided (or forced) sharded but no weight had a divisible
+            # dim: nothing runs sharded, so the record — and everything
+            # downstream that prices or audits it — must say replicated
+            self.update_sharding.update(
+                enabled=False, shards=1, axes=[],
+                reason=self.update_sharding.get("reason", "")
+                + "+no_shardable_weight")
+        if self.update_specs:
+            telemetry.event(
+                "weight_update",
+                shards=int(self.update_sharding.get("shards", 1)),
+                buckets=buckets, sharded_weights=len(self.update_specs),
+                bytes=total_bytes)
+
+    def _map_update_leaves(self, tree, fn):
+        """Apply `fn(leaf, NamedSharding)` to every leaf carrying an
+        update sharding (no-op when disabled). Leaves are matched by the
+        (node, weight) tail of their tree path — the same two keys for
+        params/grads ({node: {w}}) and slot trees ({m: {node: {w}}}) —
+        and only when the leaf has the weight's full shape (SGD's
+        momentum-off scalar slots pass through)."""
+        if not self.update_specs:
+            return tree
+        import jax.tree_util as jtu
+
+        flat, treedef = jtu.tree_flatten_with_path(tree)
+        out = []
+        for path, leaf in flat:
+            keys = tuple(k.key for k in path if isinstance(k, jtu.DictKey))
+            entry = (self.update_specs.get(keys[-2:])
+                     if len(keys) >= 2 else None)
+            if entry is not None and tuple(
+                    getattr(leaf, "shape", ())) == entry[1]:
+                leaf = fn(leaf, NamedSharding(self.mesh, entry[0]))
+            out.append(leaf)
+        return jtu.tree_unflatten(treedef, out)
+
+    def _pin_update_sharding(self, tree):
+        """Constrain grads / updated params / optimizer slots to their
+        update shardings inside the jitted step."""
+        return self._map_update_leaves(
+            tree, jax.lax.with_sharding_constraint)
+
+    def place_update_sharded(self, tree):
+        """device_put leaves onto their update shardings (outside jit) —
+        compile-time placement of optimizer slots built by zeros_like, and
+        insurance that params/slots restored or constructed elsewhere land
+        at rest in the sharded layout."""
+        return self._map_update_leaves(tree, jax.device_put)
 
     def _cast_compute(self, tree):
         """Cast float leaves to the compute dtype (inside jit; the VJP of the
@@ -185,6 +324,12 @@ class Executor:
                 key = _stable_fold(rng, f"{node.name}/{ws.name}")
                 arr = init(key, ws.shape, dtype_to_jnp(ws.dtype))
                 spec = node.weight_axes.get(ws.name, PartitionSpec())
+                upd = self.update_specs.get((node.name, ws.name))
+                if upd is not None:
+                    # at-rest layout under weight-update sharding: the
+                    # fp32 master lives 1/dp-sharded; consumers all-gather
+                    # at first use (fused with their compute-dtype cast)
+                    spec = upd[0]
                 arr = jax.device_put(arr, NamedSharding(self.mesh, spec))
                 (p if ws.trainable else s)[ws.name] = arr
             if p:
@@ -282,9 +427,35 @@ class Executor:
             loss_fn, has_aux=True
         )(params)
         new_state = self._restore_state_dtypes(new_state)
+        if self.update_specs:
+            # sharded weight update (ZeRO / Xu et al.): pin each bucket's
+            # gradient to the 1/dp update layout, so GSPMD lowers the dp
+            # psum into a reduce-scatter per layer-order bucket — the hop
+            # for bucket k free to overlap the backward compute producing
+            # bucket k+1 (no data dependence between them; the same
+            # latency-hiding the ring bodies exploit). The sharded update
+            # below then touches only this replica's shard; the updated
+            # params stay sharded at rest and each consumer's first use
+            # next step all-gathers them, fused with its compute cast.
+            # Bit-identical: the same reduced gradient elements feed the
+            # same element-wise update — each replica just owns a slice.
+            # (The span fires at trace time — one per compile, labelling
+            # the executable that carries the RS/AG schedule.)
+            from . import telemetry
+
+            with telemetry.span(
+                    "grad_sync",
+                    shards=int(self.update_sharding.get("shards", 1)),
+                    buckets=int(self.update_sharding.get("buckets", 0))):
+                with jax.named_scope("grad_sync"):
+                    grads = self._pin_update_sharding(grads)
         new_params, new_slots = self.optimizer.update(
             grads, params, opt_slots, step
         )
+        if self.update_specs:
+            with jax.named_scope("weight_update_shard"):
+                new_params = self._pin_update_sharding(new_params)
+                new_slots = self._pin_update_sharding(new_slots)
         counters = self.metrics.compute(
             counters, logits, self.expand_labels(labels),
             from_logits=not self.last_op_is_softmax, scce_sum=ce_sum,

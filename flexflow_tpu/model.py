@@ -130,6 +130,11 @@ class FFModel:
         self._plan_fingerprint = None
         self._warmstart = None
         self._plan_record = None
+        # weight-update sharding decision (unity.choose_update_sharding):
+        # whether fp32 masters + optimizer slots run ZeRO-sharded 1/dp
+        # with the grad sync as an overlappable reduce-scatter; recorded
+        # in checkpoint manifests + strategy_report.json
+        self._update_sharding = None
 
     # ================================================== tensor creation
 
@@ -765,6 +770,7 @@ class FFModel:
         # --- mesh + strategy
         self.mesh = build_mesh(self.config.mesh_shape())
         used_substitutions = False
+        search_cost_model = None  # set by the search branch (calibrated)
         if self.config.warmstart_dir and self._warmstart is None:
             # attach the warm-start subsystem early: pointing JAX's
             # persistent compilation cache under the warm-start dir must
@@ -833,6 +839,19 @@ class FFModel:
             )
             cost_model = CostModel(
                 machine, opt_slots=self.optimizer.num_slots)
+            if (self.config.weight_update_sharding
+                    and self.config.computation_mode
+                    == CompMode.COMP_MODE_TRAINING):
+                # forced sharded update: the placement search itself must
+                # price sync as the overlappable RS+AG + 1/dp state (auto
+                # mode decides after the placements are materialized —
+                # choose_update_sharding below). Inference compiles — a
+                # serving replay inherits the trainer's config — have no
+                # grad sync or optimizer state to price.
+                cost_model.update_sharding = True
+                cost_model.overlap_update = bool(
+                    self.config.overlap_collectives)
+            search_cost_model = cost_model
 
             _calibrated = [False]
 
@@ -1094,13 +1113,72 @@ class FFModel:
         batch_axes = label_spec[0] if len(label_spec) > 0 else None
         self.label_spec = PartitionSpec(batch_axes)
 
+        # --- weight-update sharding: the update-dimension half of the
+        # search, decided AFTER every branch materialized its placements
+        # (the decision prices the live graph's assignments). The chosen
+        # mode is what the executor places/pins and what the explain
+        # report / drift monitor price.
+        from .search.unity import choose_update_sharding
+
+        if search_cost_model is None and self._warmstart is not None:
+            # no local search ran (warm-start plan hit / checkpoint /
+            # import / dp fallback): price the decision with the SAME
+            # persisted calibration a cold --calibrate run consumed —
+            # a roofline-only cost model could flip the auto decision
+            # between a cold run and a warm restart of the identical job
+            # (parity with the replayed strategy report, explain.py)
+            from .search.cost_model import CostModel
+            from .search.machine_model import machine_model_for_mesh
+
+            search_cost_model = CostModel(
+                machine_model_for_mesh(
+                    self.mesh, num_hosts=self.config.num_nodes),
+                opt_slots=self.optimizer.num_slots)
+            self._warmstart.calibration_db.load_into(search_cost_model)
+        self._update_sharding = choose_update_sharding(
+            g, self.mesh, self.config, cost_model=search_cost_model,
+            opt_slots=self.optimizer.num_slots)
+        if jax.process_count() > 1:
+            # the auto verdict prices with process-divergent cost models
+            # (calibration + the warm-start DB live on process 0 only) and
+            # its thresholds can land on opposite sides across hosts —
+            # adopt the coordinator's decision everywhere so every process
+            # pins the same update layout into the one jitted step
+            from .distributed import broadcast_json, is_coordinator
+
+            self._update_sharding = broadcast_json(
+                self._update_sharding if is_coordinator() else None)
+            if search_cost_model is not None:
+                # keep the local cost model pricing the ADOPTED mode (the
+                # strategy report / drift monitor must describe what runs)
+                search_cost_model.update_sharding = (
+                    self._update_sharding["enabled"])
+                search_cost_model.overlap_update = (
+                    self._update_sharding["enabled"]
+                    and bool(self.config.overlap_collectives))
+
         self.executor = Executor(
             g, self.mesh, self.config, self.loss_type, self.metrics,
             self.optimizer, logits_node, self.label_spec,
+            update_sharding=self._update_sharding,
         )
+        # adopt the REALIZED record (the executor resolves the decision
+        # into per-weight specs and may widen shards/axes beyond the dp
+        # default, e.g. over `seq`): manifests, the strategy report, and
+        # the decision event below must describe what runs
+        self._update_sharding = self.executor.update_sharding
+        telemetry.event(
+            "weight_update_decision",
+            enabled=self._update_sharding["enabled"],
+            shards=self._update_sharding["shards"],
+            reason=self._update_sharding.get("reason", ""))
         self._rng = jax.random.key(self.config.seed)
         self._params, self._state = self.executor.init_variables(self._rng)
-        self._opt_slots = self.executor.replicate(self.optimizer.init(self._params))
+        # optimizer slots inherit the (possibly update-sharded) param
+        # placement via zeros_like; place_update_sharded is the explicit
+        # guarantee (momentum-off scalar slots pass through untouched)
+        self._opt_slots = self.executor.place_update_sharded(
+            self.executor.replicate(self.optimizer.init(self._params)))
         self._state = self.executor.replicate(self._state) if self._state else self._state
         self._step = self.executor.replicate(jnp.zeros((), jnp.int32))
         self._counters = self.executor.replicate(self.metrics.zero_counters())

@@ -10,6 +10,18 @@ batch-sharded loss meets replicated weights — the exact role of
 element-wise on whatever sharding each parameter carries. Optimizer slots
 (momentum `v`, Adam `m`) inherit the parameter's sharding, giving ZeRO-style
 sharded optimizer state for free whenever parameters are sharded.
+
+Under weight-update sharding (--weight-update-sharding, or Unity's
+choose_update_sharding deciding the plan is memory- or grad-sync-bound) the
+executor additionally pins grads / fp32 masters / slots of data-parallel
+weights to a 1/dp layout along the gradient-reduction axes before and after
+`update`, so the replicated-weight psum above lowers to an overlappable
+reduce-scatter, these updates run on each replica's shard only, and the
+updated-param all-gather is deferred into each consumer's first use next
+step (ZeRO, Rajbhandari et al. SC'20; Xu et al. 2020). The optimizers here
+need no change for that: `update` is element-wise over pytree leaves, so it
+is bit-identical whichever slice of the reduced gradient a replica owns —
+exactly why the sharded and replicated trajectories match bit-for-bit.
 """
 
 from __future__ import annotations

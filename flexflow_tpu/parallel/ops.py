@@ -34,7 +34,7 @@ the capability the reference never implemented.
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
-from typing import Tuple
+from typing import Optional, Tuple
 
 from ..fftype import OperatorType as OT
 from ..tensor import ParallelDim, ParallelTensorShape
@@ -280,6 +280,167 @@ def allgather_matmul(x, w, *, mesh=None, axis_name: str | None = None,
         check_vma=False,
     )
     return fn(x, w)
+
+
+# ------------------------------------------------- weight-update sharding
+# ZeRO (Rajbhandari et al., SC '20) / TPU weight-update sharding (Xu et
+# al., 2020): every data-parallel replica redundantly stores fp32 masters
+# + optimizer slots and redundantly runs the identical update. Sharding
+# the update 1/dp along the gradient-reduction axes keeps the math
+# bit-identical (the same reduced gradient elements feed the same
+# element-wise update — each replica just owns a slice) while optimizer
+# state shrinks by the replica count and the grad all-reduce splits into
+# an overlappable reduce-scatter + a deferred all-gather. The helpers
+# below are the ONE shared definition of "which dim shards over which
+# axes" — the executor's placement, the cost model's memory/comm pricing,
+# and the tests all resolve through them so runtime and search cannot
+# disagree.
+
+
+def choose_update_dim(shape, assignment, axes, axis_sizes) -> Optional[int]:
+    """The dim of a weight `shape` to shard for the ZeRO-style update, or
+    None when no dim is shardable. `assignment` is the weight's existing
+    per-dim axis assignment (tuples of mesh-axis names), `axes` the update
+    axes (the axes the gradient is reduced over). Picks the FIRST dim
+    whose size divides by (existing degree × update degree) — first, not
+    largest, so the choice is a deterministic function of the spec alone.
+    Weights already sharded over any update axis are skipped (their
+    optimizer state is already distributed along it)."""
+    deg = 1
+    for ax in axes:
+        deg *= axis_sizes.get(ax, 1)
+    if deg <= 1:
+        return None
+    used = {ax for entry in (assignment or ()) for ax in entry}
+    if used.intersection(axes):
+        return None
+    for i, size in enumerate(shape):
+        have = 1
+        if assignment and i < len(assignment):
+            for ax in assignment[i]:
+                have *= axis_sizes.get(ax, 1)
+        if size % (have * deg) == 0:
+            return i
+    return None
+
+
+def grad_sync_axes(out_axes, weight_axes) -> Tuple[str, ...]:
+    """The mesh axes a trainable weight's gradient is reduced over: every
+    axis its consumers' activations shard that the weight itself does not
+    (the axes the NCCL allreduce of optimizer_kernel.cu:78-110 spans) —
+    sorted, so executor placement and cost-model pricing compose the same
+    PartitionSpec entry."""
+    return tuple(sorted(set(out_axes) - set(weight_axes)))
+
+
+def _spec_assignment(spec, ndim):
+    """PartitionSpec (or None) → per-dim axis tuples."""
+    entries = []
+    for i in range(ndim):
+        e = spec[i] if spec is not None and i < len(spec) else None
+        if e is None:
+            entries.append(())
+        elif isinstance(e, (tuple, list)):
+            entries.append(tuple(e))
+        else:
+            entries.append((e,))
+    return tuple(entries)
+
+
+def weight_update_spec(shape, base_spec, axes, axis_sizes):
+    """PartitionSpec of a weight's fp32 master / grad / optimizer slots
+    under weight-update sharding: `base_spec` (the plan's compute
+    placement) with the update `axes` appended onto the dim
+    `choose_update_dim` picks. None when the weight is not shardable
+    (stays replicated — partial coverage is fine; the update there is the
+    replicated baseline, still bit-identical)."""
+    from jax.sharding import PartitionSpec
+
+    assignment = _spec_assignment(base_spec, len(shape))
+    dim = choose_update_dim(shape, assignment, axes, axis_sizes)
+    if dim is None:
+        return None
+    entries = []
+    for i, entry in enumerate(assignment):
+        merged = entry + tuple(axes) if i == dim else entry
+        if not merged:
+            entries.append(None)
+        elif len(merged) == 1:
+            entries.append(merged[0])
+        else:
+            entries.append(tuple(merged))
+    return PartitionSpec(*entries)
+
+
+def _rs_local(x, *, axis_name: str, n: int, overlap: bool):
+    """Per-shard ring reduce-scatter body: `x` (m, ...) is this shard's
+    full local contribution; returns the (m/n, ...) chunk this shard owns
+    of the cross-shard sum. The packet destined for chunk c starts on
+    shard (c+1) mod n and travels n−1 hops, accumulating each host's
+    local chunk c — the double-buffered idiom of
+    parallel/ring_attention.py: each hop has no data dependence on the
+    local chunk slice/add beside it, so the latency-hiding scheduler
+    overlaps them. `overlap=False` is the serial hop-THEN-add ablation —
+    forced with an optimization barrier, because XLA schedules by data
+    dependence, not trace order (merely reordering the statements would
+    compile to the identical program)."""
+    import jax
+
+    idx = jax.lax.axis_index(axis_name)
+    m = x.shape[0]
+    chunk = m // n
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def take(src, c):
+        return jax.lax.dynamic_slice_in_dim(src, c * chunk, chunk, axis=0)
+
+    acc = take(x, jax.lax.rem(idx - 1 + n, n))
+    for t in range(1, n):
+        moved = jax.lax.ppermute(acc, axis_name, perm)
+        src = x
+        if not overlap:
+            # serialize: the barrier makes the local slice depend on the
+            # hop's arrival, so the add cannot issue behind the permute
+            moved, src = jax.lax.optimization_barrier((moved, x))
+        acc = moved + take(src, jax.lax.rem(idx - 1 - t + 2 * n, n))
+    return acc
+
+
+def ring_reduce_scatter(x, *, mesh=None, axis_name: str | None = None,
+                        overlap: bool = True):
+    """Decomposed reduce-scatter over `axis_name`: `x` (n·m, ...) holds
+    each shard's full local contribution along dim 0 (sharded n-ways);
+    returns the (m, ...) cross-shard sum scattered along the same axis —
+    the explicit overlappable twin of the reduce-scatter GSPMD emits for
+    the sharded weight update, scheduled as n−1 double-buffered ppermute
+    hops (the grad-sync ablation in bench.py measures exactly this
+    schedule against the serial one). Falls back to a plain psum-free
+    identity when there is no mesh / the axis has size 1."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..machine import AXIS_DATA
+    from .smap import shard_map
+
+    axis_name = axis_name or AXIS_DATA
+    if mesh is None or mesh.shape.get(axis_name, 1) == 1:
+        return x
+    n = mesh.shape[axis_name]
+    if x.shape[0] % (n * n) != 0:
+        raise ValueError(
+            f"ring_reduce_scatter: dim 0 of {x.shape} must divide by "
+            f"{axis_name!r} size {n} twice (local chunking)")
+    nd = x.ndim
+    fn = shard_map(
+        functools.partial(_rs_local, axis_name=axis_name, n=n,
+                          overlap=overlap),
+        mesh=mesh,
+        in_specs=(P(axis_name, *([None] * (nd - 1))),),
+        out_specs=P(axis_name, *([None] * (nd - 1))),
+        check_vma=False,
+    )
+    return fn(x)
 
 
 def derive_parallel_assignment(op_type: OT, params, in_assignment, mesh):

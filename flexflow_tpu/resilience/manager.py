@@ -50,6 +50,19 @@ class ResilienceManager:
             "mesh_axes": {k: int(v) for k, v in mesh.shape.items()}
             if mesh is not None else {},
         }
+        upd = getattr(self.ffmodel, "_update_sharding", None)
+        if upd is not None:
+            # how the saving run ran its weight update (ZeRO-sharded vs
+            # replicated, shard count/axes): informational for elastic
+            # resume — checkpoints always hold FULL logical arrays (the
+            # snapshot gathers shards), so a resume re-places them under
+            # the RESTORING compile's update mode bit-exactly in either
+            # direction and across dp degrees
+            extras["update_sharding"] = {
+                "enabled": bool(upd.get("enabled")),
+                "shards": int(upd.get("shards", 1)),
+                "axes": list(upd.get("axes", [])),
+            }
         plan = getattr(self.ffmodel, "_plan_record", None)
         if plan:
             # the applied parallelization plan + structural fingerprint:

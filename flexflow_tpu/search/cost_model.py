@@ -45,14 +45,54 @@ class CostMetrics:
 
     forward_time: float = 0.0
     backward_time: float = 0.0
-    sync_time: float = 0.0       # gradient allreduce
+    sync_time: float = 0.0       # serial gradient allreduce (incl., under
+    #                              a sharded update, any co-located weight
+    #                              choose_update_dim could NOT shard)
     comm_time: float = 0.0       # input resharding
     memory: float = 0.0          # per-chip bytes
+    # weight-update sharding: the sharded weights' RS+AG pair (the
+    # allreduce's exact ring bytes, separated so the evaluators can route
+    # it onto the overlappable channel while sync_time stays serial), the
+    # pair's ring-hop count, the summed per-hop issue latency priced at
+    # each axis's own latency (DCN hops cost ~10× ICI), and the 1/dp
+    # optimizer-state shards — all zero under the replicated update
+    update_sync_time: float = 0.0
+    update_hops: float = 0.0
+    update_hop_s: float = 0.0
+    update_shards: int = 1
 
     @property
     def total(self) -> float:
         return (self.forward_time + self.backward_time + self.sync_time
-                + self.comm_time)
+                + self.update_sync_time + self.comm_time)
+
+
+def price_grad_sync(cm: "CostMetrics", update_sharding: bool,
+                    overlap_update: bool
+                    ) -> tuple[float, float, float, float]:
+    """(serial_sync_s, overlappable_comm_s, overlap_overhead_s,
+    grad_sync_s) of one node's gradient sync under the given update mode
+    — the ONE pricing rule both evaluators (UnitySearch.evaluate and
+    substitution.evaluate_assigned_graph) apply, so the update-sharding
+    decision can never disagree with the reported makespan. Replicated:
+    the allreduce rides sync serially. Sharded: the sharded weights'
+    RS+AG pair (update_sync_time — the allreduce's exact ring bytes)
+    plus the pair's fixed per-hop issue latency (update_hop_s, priced at
+    each axis's own latency) ride the overlappable channel when
+    overlapped (the RS hides behind the backward producing each
+    layer-order bucket, the deferred AG behind the next step's first
+    consumer), or sync serially under --no-overlap-collectives — so
+    serial-sharded prices strictly above replicated (the auto decision's
+    tie-breaker). Any co-located weight choose_update_dim could not
+    shard stays in sync_time and always prices serial, matching the
+    runtime. grad_sync_s names the sharded pair's share for the strategy
+    report."""
+    pair = cm.update_sync_time
+    if not (update_sharding and pair > 0.0):
+        return cm.sync_time + pair, 0.0, 0.0, 0.0
+    if overlap_update:
+        return cm.sync_time, pair, cm.update_hop_s, pair
+    return cm.sync_time + pair + cm.update_hop_s, 0.0, 0.0, pair
 
 
 def _shard_elems(shape: tuple[int, ...], assignment, axis_sizes) -> float:
@@ -335,6 +375,15 @@ class CostModel:
         # optimizer state entries per weight (SGD momentum 1, Adam 2) for
         # the memory model
         self.opt_slots = opt_slots
+        # weight-update sharding (ZeRO / Xu et al.): price the gradient
+        # sync as a reduce-scatter + all-gather pair (same ring bytes as
+        # the allreduce) and the masters/grads/slots at 1/shards per chip
+        # plus one gathered compute copy. overlap_update additionally
+        # routes the pair onto the overlappable channel in the evaluators
+        # (max(compute, comm) + hop latency). Toggled by
+        # unity.choose_update_sharding / --weight-update-sharding.
+        self.update_sharding = False
+        self.overlap_update = False
         self._cache: dict = {}
         self._calibration: dict = {}
 
@@ -346,7 +395,8 @@ class CostModel:
                tuple(tuple(a) for a in out_assigns or ()),
                tuple(sorted((k, str(v)) for k, v in
                             (weight_specs_assigns or {}).items())),
-               tuple(tuple(tuple(e) for e in (a or ())) for a in in_assigns))
+               tuple(tuple(tuple(e) for e in (a or ())) for a in in_assigns),
+               self.update_sharding)
         if key in self._cache:
             return self._cache[key]
 
@@ -393,23 +443,59 @@ class CostModel:
         # bytes are still touched each step, but the weight/grad/optimizer
         # memory and the gradient allreduce are owned (and already counted)
         # by the source node
+        from ..parallel.ops import choose_update_dim, grad_sync_axes
+
         tied = bool(getattr(node, "weight_source", None))
-        weight_bytes = 0.0
+        weight_mem = 0.0
         sync = 0.0
+        update_sync = 0.0
+        update_hops = 0.0
+        update_hop_s = 0.0
+        update_shards = 1
         for ws in node.weight_specs:
             spec = (weight_specs_assigns or {}).get(ws.name)
             w_assign = _spec_to_assignment(spec, len(ws.shape))
             wb = _shard_elems(ws.shape, w_assign, axis_sizes) * dtype_bytes(ws.dtype)
-            if not tied:
-                weight_bytes += wb
             bytes_touched += wb
-            if ws.trainable and not tied:
-                # gradient allreduce over every data-ish axis the weight is
-                # NOT sharded over but its consumers' activations are
+            if tied:
+                continue
+            # gradient sync over every data-ish axis the weight is NOT
+            # sharded over but its consumers' activations are; resolved
+            # through the SAME helpers the executor places with
+            # (parallel/ops), so runtime and pricing cannot disagree
+            sync_axes = ()
+            if ws.trainable:
                 w_axes = _axes_of(w_assign)
                 act_axes = _axes_of(out_assigns[0] if out_assigns else ())
-                for ax in act_axes - w_axes:
+                sync_axes = grad_sync_axes(act_axes, w_axes)
+            sharded = (
+                self.update_sharding and sync_axes
+                and choose_update_dim(ws.shape, w_assign, sync_axes,
+                                      axis_sizes) is not None)
+            if sharded:
+                shards = 1
+                for ax in sync_axes:
+                    # RS + AG together move the allreduce's exact ring
+                    # bytes; the win is the overlappable channel (the
+                    # evaluators route update_sync there — a co-located
+                    # non-shardable weight's allreduce stays in `sync`
+                    # and keeps pricing serial, matching the runtime) +
+                    # the 1/dp state below. Hop issue latency priced at
+                    # the axis's own latency (DCN hops cost ~10× ICI)
+                    update_sync += (self.machine.reduce_scatter(wb, ax)
+                                    + self.machine.all_gather(wb, ax))
+                    n = self.machine.axis_size(ax)
+                    update_hops += 2.0 * (n - 1)
+                    update_hop_s += 2.0 * (n - 1) * self.machine._lat(ax)
+                    shards *= n
+                update_shards = max(update_shards, shards)
+                # per-chip memory: one gathered compute copy + master/
+                # grad/slots sharded 1/shards (the ZeRO saving)
+                weight_mem += wb + wb * (2 + self.opt_slots) / shards
+            else:
+                for ax in sync_axes:
                     sync += self.machine.all_reduce(wb, ax)
+                weight_mem += wb * (2 + self.opt_slots)
 
         eff_peak_t = self.machine.compute_time(shard_flops / self.mfu,
                                                bytes_touched)
@@ -430,12 +516,18 @@ class CostModel:
             bwd = 2.0 * fwd
         # per-chip memory (MemoryUsage analog, memory_optimization.h:44-105):
         # master weight + gradient + optimizer slots (opt_slots: 1 for SGD
-        # momentum, 2 for Adam) + every output activation at its dtype
+        # momentum, 2 for Adam) + every output activation at its dtype;
+        # under weight-update sharding the master/grad/slot term shrank to
+        # 1/shards per weight above (plus one gathered compute copy)
         cm = CostMetrics(
             forward_time=fwd,
             backward_time=bwd,
             sync_time=sync,
-            memory=weight_bytes * (2 + self.opt_slots) + act_bytes,
+            update_sync_time=update_sync,
+            memory=weight_mem + act_bytes,
+            update_hops=update_hops,
+            update_hop_s=update_hop_s,
+            update_shards=update_shards,
         )
         self._cache[key] = cm
         return cm
@@ -770,17 +862,7 @@ def _params_key(node, in_shapes=None):
             tuple(tuple(s) for s in in_shapes))
 
 
-def _spec_to_assignment(spec, ndim):
-    """PartitionSpec (or None) → per-dim axis tuples."""
-    if spec is None:
-        return ((),) * ndim
-    entries = []
-    for i in range(ndim):
-        e = spec[i] if i < len(spec) else None
-        if e is None:
-            entries.append(())
-        elif isinstance(e, (tuple, list)):
-            entries.append(tuple(e))
-        else:
-            entries.append((e,))
-    return tuple(entries)
+# PartitionSpec (or None) → per-dim axis tuples: ONE definition, shared
+# with the executor's weight-update placement (parallel/ops) so pricing
+# and runtime can never diverge on how a spec reads
+from ..parallel.ops import _spec_assignment as _spec_to_assignment  # noqa: E402

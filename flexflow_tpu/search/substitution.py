@@ -701,17 +701,24 @@ def assign_axes_from_degrees(graph: Graph, mesh):
 
 # ------------------------------------------------------------- graph costing
 
-def evaluate_graph(graph: Graph, mesh, cm: CostModel,
-                   overlap_sync: bool = False) -> tuple[float, float]:
-    """(time, per-chip memory) of a rewritten PCG: compute ops through the
-    cost model on their emitted assignments; parallel ops priced as the
-    collectives they lower to (the reference prices them as partition-copy
-    tasks via the simulator). Total time is the task-graph makespan
-    (graph_makespan / native ff_eval_makespan) — comm on concurrent
-    branches overlaps compute of other ops instead of summing serially."""
-    from .cost_model import _MakespanAccum
+def evaluate_assigned_graph(graph: Graph, mesh, cm: CostModel,
+                            overlap_sync: bool = False,
+                            totals: dict | None = None
+                            ) -> tuple[float, float]:
+    """(time, per-chip memory) of a PCG on its ALREADY-materialized
+    assignments — no re-derivation, so it is safe on a compiled model
+    whose strategy was applied by `_assign_strategy` (the
+    weight-update-sharding decision prices the live graph through here).
+    Compute ops go through the cost model on their emitted assignments;
+    parallel ops are priced as the collectives they lower to. Total time
+    is the task-graph makespan. When the cost model prices a ZeRO-sharded
+    update (cm.update_sharding + cm.overlap_update), the grad RS+AG rides
+    the overlappable channel — max(compute, comm) + hop latency — exactly
+    as UnitySearch.evaluate prices it. `totals`, when a dict, additionally
+    accumulates the summed grad-sync seconds under "sync_s" (the
+    update-sharding decision reads the sync fraction off it)."""
+    from .cost_model import _MakespanAccum, price_grad_sync
 
-    assign_axes_from_degrees(graph, mesh)
     acc = _MakespanAccum(overlap_sync=overlap_sync)
     mem = 0.0
     machine = cm.machine
@@ -729,11 +736,31 @@ def evaluate_graph(graph: Graph, mesh, cm: CostModel,
         cmx = cm.op_cost(
             node, [_logical_assignment(pt) for pt in node.outputs],
             dict(node.weight_axes), in_shapes, in_assigns)
+        grad_sync = cmx.sync_time + cmx.update_sync_time
+        if totals is not None:
+            totals["sync_s"] = totals.get("sync_s", 0.0) + grad_sync
+        # the shared update-mode pricing rule (cost_model.price_grad_sync
+        # — the same rule UnitySearch.evaluate applies, so the decision
+        # made through here matches the reported makespan)
+        sync, overlap_comm, overlap_overhead, _ = price_grad_sync(
+            cmx, cm.update_sharding, getattr(cm, "overlap_update", False))
         acc.add(node.guid, cmx.forward_time + cmx.backward_time,
-                cmx.comm_time, sync=cmx.sync_time,
-                comm_axes=(AXIS_DATA,) if cmx.sync_time > 0 else ())
+                cmx.comm_time, sync=sync,
+                comm_axes=(AXIS_DATA,) if grad_sync > 0 else (),
+                overlappable_comm=overlap_comm,
+                overlap_overhead=overlap_overhead)
         mem += cmx.memory
     return acc.makespan(graph.in_edges), mem
+
+
+def evaluate_graph(graph: Graph, mesh, cm: CostModel,
+                   overlap_sync: bool = False) -> tuple[float, float]:
+    """(time, per-chip memory) of a rewritten PCG: materialize the
+    rewrite's degree-derived assignments first (assign_axes_from_degrees
+    — the FFMapper analog), then price via evaluate_assigned_graph."""
+    assign_axes_from_degrees(graph, mesh)
+    return evaluate_assigned_graph(graph, mesh, cm,
+                                   overlap_sync=overlap_sync)
 
 
 def _logical_assignment(pt: ParallelTensor):

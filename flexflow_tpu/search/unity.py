@@ -44,6 +44,7 @@ from .cost_model import (
     _spec_to_assignment,
     classify_reshard,
     dtype_bytes,
+    price_grad_sync,
     price_parallel_node,
 )
 from .machine_model import TPUMachineModel
@@ -438,6 +439,15 @@ class UnitySearch:
                         3.0 * hops * self.cm.machine._lat(AXIS_SEQ))
                 else:
                     psum += ring_comm
+            grad_sync = cm.sync_time + cm.update_sync_time
+            # the shared update-mode pricing rule (cost_model.
+            # price_grad_sync — also what choose_update_sharding decides
+            # through, via evaluate_assigned_graph)
+            sync_arg, gs_overlap, gs_overhead, grad_sync_sharded = (
+                price_grad_sync(cm, self.cm.update_sharding,
+                                self.cm.overlap_update))
+            overlap_comm += gs_overlap
+            overlap_overhead += gs_overhead
             compute_t = cm.forward_time + cm.backward_time
             if (cfg.name == "pp"
                     and node.op_type == OT.OP_PIPE_BLOCKS):
@@ -465,12 +475,12 @@ class UnitySearch:
                 psum += 3.0 * (M + P - 1) * self.cm.machine.ppermute(
                     mb_bytes, AXIS_PIPE)
                 comm_axes = comm_axes + (AXIS_PIPE,)
-            if not comm_axes and cm.sync_time > 0:
-                comm_axes = (AXIS_DATA,)  # gradient allreduce rides `data`
+            if not comm_axes and grad_sync > 0:
+                comm_axes = (AXIS_DATA,)  # gradient sync rides `data`
             acc.add(node.guid,
                     compute_t,
                     cm.comm_time + reshard + psum,
-                    comm_axes=comm_axes, sync=cm.sync_time,
+                    comm_axes=comm_axes, sync=sync_arg,
                     overlappable_comm=overlap_comm,
                     overlap_overhead=overlap_overhead)
             mem += cm.memory
@@ -484,13 +494,17 @@ class UnitySearch:
                     "op_type": node.op_type.name, "config": cfg.name,
                     "forward_s": cm.forward_time * stretch,
                     "backward_s": cm.backward_time * stretch,
-                    "sync_s": cm.sync_time,
+                    "sync_s": sync_arg,
                     "reshard_s": reshard,
                     "collective_s": cm.comm_time + psum,
                     # overlap-capable collective traffic (hidden behind
-                    # this op's compute; still occupies its ICI axis)
+                    # this op's compute; still occupies its ICI axis) —
+                    # ring hops plus, under weight-update sharding, the
+                    # grad RS+AG (grad_sync_s names that share)
                     "overlap_s": overlap_comm,
                     "overlap_overhead_s": overlap_overhead,
+                    "grad_sync_s": grad_sync_sharded,
+                    "update_shards": cm.update_shards,
                     "memory_bytes": cm.memory,
                     "comm_axes": list(comm_axes)})
         if collect is not None:
@@ -896,6 +910,111 @@ def lambda_memory_search(make_search, hbm_bytes: float, iters: int = 5):
             best = (choice, s)
             hi = mid
     return best or last
+
+
+def choose_update_sharding(graph, mesh, config,
+                           cost_model: Optional[CostModel] = None,
+                           opt_slots: int = 1) -> dict:
+    """Decide whether the weight update runs ZeRO-sharded (Xu et al. 2020)
+    or replicated — the update-dimension half of the Unity search, priced
+    by the same evaluator after the per-node placements are materialized
+    on the graph.
+
+    The two candidates move the same ring bytes (allreduce ≡ RS+AG), so
+    the decision is exactly the paper's tradeoff: sharded wins when the
+    plan is GRAD-SYNC-BOUND (the overlappable channel hides the pair
+    behind backward compute while the replicated allreduce serializes) or
+    MEMORY-BOUND (masters + slots at 1/dp bring the plan under the
+    per-chip HBM cap); replicated wins when the model is so small that
+    the pair's fixed per-hop issue latency exceeds the sync it hides (the
+    2% margin keeps tiny CI models on the replicated baseline rather
+    than flip-flopping on pricing noise). `--weight-update-sharding` /
+    `--no-weight-update-sharding` force the outcome; both trajectories
+    are bit-identical, so forcing is always safe.
+
+    Returns the decision record the model stashes (`_update_sharding`),
+    checkpoint manifests embed, and strategy_report.json surfaces. As a
+    side effect the cost model is left pricing the CHOSEN update mode, so
+    the explain report / drift monitor describe the running config."""
+    from ..fftype import CompMode
+    from ..machine import batch_axes_for
+    from .machine_model import machine_model_for_mesh
+    from .substitution import evaluate_assigned_graph
+
+    axis_sizes = {k: int(v) for k, v in dict(mesh.shape).items()}
+    axes = batch_axes_for(axis_sizes)
+    shards = 1
+    for ax in axes:
+        shards *= axis_sizes.get(ax, 1)
+    decision = {
+        "enabled": False,
+        "shards": shards,
+        "axes": list(axes),
+        "forced": config.weight_update_sharding,
+    }
+    trainable = any(
+        ws.trainable
+        for n in graph.topo_order()
+        if not getattr(n, "weight_source", None)
+        for ws in n.weight_specs)
+    if (shards <= 1 or not trainable
+            or config.computation_mode != CompMode.COMP_MODE_TRAINING):
+        decision["reason"] = ("no_grad_sync" if shards <= 1 or not trainable
+                              else "inference")
+        return decision
+    cm = cost_model or CostModel(
+        machine_model_for_mesh(mesh, num_hosts=config.num_nodes),
+        opt_slots=opt_slots)
+    cap = (config.device_mem if config.device_mem > 0
+           else cm.machine.chip.hbm_bytes)
+
+    def _priced(flag: bool, totals=None):
+        cm.update_sharding = flag
+        cm.overlap_update = flag and bool(config.overlap_collectives)
+        # same overlap_sync the real evaluator prices with — the decision
+        # and the strategy report must read the same makespan rule
+        t, mem = evaluate_assigned_graph(
+            graph, mesh, cm,
+            overlap_sync=bool(config.search_overlap_backward_update),
+            totals=totals)
+        pen = t * (1.0 + 10.0 * (mem - cap) / cap) if mem > cap else t
+        return t, mem, pen
+
+    rep_totals: dict = {}
+    t_rep, mem_rep, c_rep = _priced(False, totals=rep_totals)
+    t_sh, mem_sh, c_sh = _priced(True)
+    sync_frac = (rep_totals.get("sync_s", 0.0) / t_rep if t_rep > 0
+                 else 0.0)
+    if config.weight_update_sharding is not None:
+        # forced either way (both trajectories are bit-identical, so
+        # forcing is always safe); the candidates are still both priced so
+        # the decision record / bench ablation carry the comparison
+        enabled = config.weight_update_sharding
+        decision["reason"] = "flag"
+    else:
+        # grad-sync-bound: the replicated allreduce is a material slice
+        # (≥10%) of the predicted step AND the overlappable pricing is
+        # ≥2% cheaper — tiny models whose sync the hop latency would
+        # dominate stay replicated rather than flip-flop on noise
+        memory_bound = mem_rep > cap and c_sh < c_rep
+        overlap_bound = c_sh < 0.98 * c_rep and sync_frac >= 0.1
+        enabled = memory_bound or overlap_bound
+        decision["reason"] = ("memory_bound" if memory_bound
+                              else "overlap_bound" if overlap_bound
+                              else "replicated_cheaper")
+    decision["enabled"] = enabled
+    decision["predicted"] = {
+        "replicated_s": t_rep, "sharded_s": t_sh,
+        "replicated_cost_s": c_rep, "sharded_cost_s": c_sh,
+        "replicated_mem_bytes": mem_rep, "sharded_mem_bytes": mem_sh,
+        "grad_sync_fraction": sync_frac,
+        "hbm_cap_bytes": cap,
+    }
+    # leave the cost model pricing the chosen mode (the strategy report
+    # and the drift monitor's predicted makespan must describe what runs)
+    cm.update_sharding = enabled
+    cm.overlap_update = enabled and bool(config.overlap_collectives)
+    return decision
 
 
 def search_strategy(graph, mesh, config,

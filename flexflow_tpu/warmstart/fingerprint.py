@@ -48,6 +48,12 @@ _SEARCH_CONFIG_FIELDS = (
     # compute + comm (search/cost_model.py) — toggling it can flip the
     # winning strategy, so plans must not share an address across it
     "overlap_collectives",
+    # weight-update sharding (ZeRO-style sharded optimizer): forcing it
+    # changes how the search prices grad sync + per-chip memory, and the
+    # raw None/True/False is the deterministic input to the update-mode
+    # decision (unity.choose_update_sharding) — plans must not share an
+    # address across it
+    "weight_update_sharding",
     "computation_dtype", "allow_tensor_op_math_conversion",
     "force_tensor_op_math",
     # serving (serving/): a decode graph compiles under

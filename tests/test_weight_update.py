@@ -1,0 +1,433 @@
+"""Weight-update sharding (ZeRO / Xu et al. 2020) tests.
+
+The acceptance bar: the sharded update is BIT-IDENTICAL to the replicated
+baseline — same reduced gradient elements feed the same element-wise
+update, each replica just owns a slice — over multi-epoch trajectories
+(params, Adam slots, RNG, counters), through kill→auto-resume across an
+update-mode toggle and a mesh change, while Unity's update-dimension
+decision (choose_update_sharding) flips to the sharded plan exactly when
+the config is memory-bound and stays replicated when overlap pricing is
+off and memory fits.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.quick
+
+DP4 = (4, 1, 1, 1)
+DP8 = (8, 1, 1, 1)
+DP2_TP2 = (2, 2, 1, 1)
+
+
+def _mlp(batch=8, mesh=DP4, seed=0, argv=(), opt="adam"):
+    sys.argv = ["test", *argv]
+    from flexflow_tpu import (
+        ActiMode, AdamOptimizer, FFConfig, FFModel, LossType, MetricsType,
+        SGDOptimizer,
+    )
+
+    config = FFConfig()
+    config.mesh_axis_sizes = mesh
+    config.batch_size = batch
+    config.seed = seed
+    ff = FFModel(config)
+    x = ff.create_tensor((batch, 16), name="x")
+    t = ff.dense(x, 32, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 4, name="fc2")
+    t = ff.softmax(t, name="sm")
+    optimizer = (AdamOptimizer(alpha=0.01) if opt == "adam"
+                 else SGDOptimizer(lr=0.05, momentum=0.9))
+    ff.compile(optimizer=optimizer,
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    return ff
+
+
+def _data(n=64, d=16, k=4, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, d).astype(np.float32)
+    y = rs.randint(0, k, (n, 1)).astype(np.int32)
+    return x, y
+
+
+def _full_state(ff):
+    """Every trajectory-defining leaf, fetched to host."""
+    import jax
+
+    return {
+        "params": jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), ff._params),
+        "slots": jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), ff._opt_slots),
+        "counters": jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), ff._counters),
+        "step": np.asarray(jax.device_get(ff._step)),
+        "rng": np.asarray(jax.random.key_data(ff._rng)),
+    }
+
+
+def _assert_bit_equal(a, b, what=""):
+    import jax
+
+    fa, _ = jax.tree_util.tree_flatten_with_path(a)
+    fb, _ = jax.tree_util.tree_flatten_with_path(b)
+    assert len(fa) == len(fb)
+    for (pa, la), (_, lb) in zip(fa, fb):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), (
+            f"{what}{jax.tree_util.keystr(pa)} differs: "
+            f"max|Δ|={np.max(np.abs(np.asarray(la, np.float64) - np.asarray(lb, np.float64)))}")
+
+
+# ===================================================================
+# bit-exact trajectory parity
+# ===================================================================
+
+@pytest.mark.parametrize("opt", ["adam", "sgd_momentum"])
+def test_sharded_update_bit_identical_trajectory(opt):
+    """2 shuffled epochs under the forced-sharded update equal the
+    replicated baseline bit-for-bit: params, optimizer slots (Adam m/v or
+    SGD momentum), metric counters, step counter, RNG key."""
+    x, y = _data(64)
+
+    rep = _mlp(argv=["--no-weight-update-sharding"], opt=opt)
+    rep.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+
+    sh = _mlp(argv=["--weight-update-sharding"], opt=opt)
+    assert sh._update_sharding["enabled"] and sh._update_sharding["shards"] == 4
+    assert sh.executor.update_specs, "no weight got an update sharding"
+    sh.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+
+    assert not rep._update_sharding["enabled"]
+    _assert_bit_equal(_full_state(rep), _full_state(sh))
+
+
+def test_sharded_masters_and_slots_live_1_over_dp():
+    """The at-rest layout really is ZeRO: fp32 masters and both Adam slots
+    of every sharded weight are placed 1/dp along the update axis — each
+    chip's addressable shard holds 1/4 of the bytes the replicated layout
+    would — and the executor's decision record counts them."""
+    ff = _mlp(argv=["--weight-update-sharding"])
+    specs = ff.executor.update_specs
+    assert ("fc1", "kernel") in specs and ("fc2", "kernel") in specs
+    for (node, wname), (spec, shape) in specs.items():
+        axes = [ax for entry in spec for ax in
+                ((entry,) if isinstance(entry, str) else (entry or ()))]
+        assert "data" in axes, (node, wname, spec)
+    k = ff._params["fc1"]["kernel"]
+    shard = k.addressable_shards[0].data
+    assert shard.size * 4 == k.size, (shard.shape, k.shape)
+    for slot_tree in ff._opt_slots.values():
+        s = slot_tree["fc1"]["kernel"]
+        assert s.addressable_shards[0].data.size * 4 == s.size
+    upd = ff.executor.update_sharding
+    assert upd["sharded_weights"] == len(specs) and upd["buckets"] >= 2
+
+
+# ===================================================================
+# kill → auto-resume across update modes and meshes
+# ===================================================================
+
+def test_kill_resume_toggled_update_mode_bit_exact(tmp_path):
+    """Death mid-fit under the SHARDED update, auto-resume under the
+    REPLICATED update on the same mesh: the final state is bit-equal to an
+    uninterrupted replicated run — checkpoints hold full logical arrays,
+    so the restoring compile re-places them under its own update mode."""
+    from flexflow_tpu.resilience import FaultInjector, SimulatedPreemption
+
+    x, y = _data(64)
+    root = str(tmp_path / "ck")
+
+    ref = _mlp(argv=["--no-weight-update-sharding"])
+    ref.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+
+    ff1 = _mlp(argv=["--weight-update-sharding",
+                     "--checkpoint-dir", root, "--checkpoint-every", "2"])
+    fault = FaultInjector(kill_after_step=5)
+    ff1.set_fault_hook(fault)
+    with pytest.raises(SimulatedPreemption):
+        ff1.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+    del ff1
+
+    ff2 = _mlp(argv=["--no-weight-update-sharding",
+                     "--checkpoint-dir", root, "--auto-resume"])
+    ff2.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+    _assert_bit_equal(_full_state(ref), _full_state(ff2))
+
+
+def test_kill_resume_across_dp_change_and_back(tmp_path):
+    """The acceptance scenario: dp=4 sharded → (kill) → dp=2×tp=2
+    replicated → (checkpoint) → back to dp=4 sharded. The trajectory
+    continues across both reshard directions; the tp=2 leg changes matmul
+    reduction order, so the cross-mesh comparison is the resilience
+    suite's fp tolerance, not bit-equality."""
+    import jax
+
+    from flexflow_tpu.resilience import FaultInjector, SimulatedPreemption
+
+    x, y = _data(64)
+    root = str(tmp_path / "ck")
+
+    ref = _mlp(mesh=DP4, argv=["--no-weight-update-sharding"])
+    ref.fit(x, y, epochs=3, batch_size=8, shuffle=True)
+    ref_state = _full_state(ref)
+
+    # leg 1: dp=4, ZeRO-sharded update, dies at step 5 (last commit: 4)
+    ff1 = _mlp(mesh=DP4, argv=["--weight-update-sharding",
+                               "--checkpoint-dir", root,
+                               "--checkpoint-every", "2"])
+    ff1.set_fault_hook(FaultInjector(kill_after_step=5))
+    with pytest.raises(SimulatedPreemption):
+        ff1.fit(x, y, epochs=3, batch_size=8, shuffle=True)
+    del ff1
+
+    # leg 2: dp=2×tp=2, replicated update, finishes epoch 2 then "dies"
+    # after its final save (manifest records the replicated update mode)
+    ff2 = _mlp(mesh=DP2_TP2, argv=["--no-weight-update-sharding",
+                                   "--checkpoint-dir", root,
+                                   "--auto-resume"])
+    ff2.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+    assert not ff2._update_sharding["enabled"]
+    ff2._resilience.save(int(np.asarray(jax.device_get(ff2._step))),
+                         cursor={"epoch": 2, "batch": 0}, blocking=True)
+    mani = ff2._resilience.peek_latest()[1]
+    assert mani["update_sharding"]["enabled"] is False
+    assert mani["mesh_axes"]["model"] == 2
+    del ff2
+
+    # leg 3: back on dp=4 with the sharded update, finishes epoch 3
+    ff3 = _mlp(mesh=DP4, argv=["--weight-update-sharding",
+                               "--checkpoint-dir", root, "--auto-resume"])
+    ff3.fit(x, y, epochs=3, batch_size=8, shuffle=True)
+    assert ff3._update_sharding["enabled"]
+    got = _full_state(ff3)
+    assert np.array_equal(got["step"], ref_state["step"])
+    for sec in ("params", "slots", "counters"):
+        fa, _ = jax.tree_util.tree_flatten_with_path(ref_state[sec])
+        fb, _ = jax.tree_util.tree_flatten_with_path(got[sec])
+        for (pa, la), (_, lb) in zip(fa, fb):
+            np.testing.assert_allclose(
+                np.asarray(la), np.asarray(lb), rtol=2e-4, atol=1e-6,
+                err_msg=f"{sec}{jax.tree_util.keystr(pa)} diverged across "
+                        f"dp4-sharded→dp2tp2-replicated→dp4-sharded")
+
+
+def test_checkpoint_manifest_records_update_sharding(tmp_path):
+    """Manifests carry the saving run's update mode (shards, axes) so
+    post-mortems and elastic resume can see how the writer ran."""
+    import jax
+
+    x, y = _data(32)
+    root = str(tmp_path / "ck")
+    ff = _mlp(argv=["--weight-update-sharding", "--checkpoint-dir", root])
+    ff.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+    ff._resilience.save(int(np.asarray(jax.device_get(ff._step))),
+                        cursor={"epoch": 1, "batch": 0}, blocking=True)
+    _, extras = ff._resilience.peek_latest()
+    upd = extras["update_sharding"]
+    assert upd == {"enabled": True, "shards": 4, "axes": ["data"]}
+
+
+# ===================================================================
+# the update-dimension search (choose_update_sharding) + cost model
+# ===================================================================
+
+def test_memory_pressure_flips_search_to_sharded():
+    """Auto mode (no flag): with per-chip HBM capped below the replicated
+    plan's footprint (-ll:fsize), Unity's update-dimension decision flips
+    to the sharded update; the predicted sharded memory is genuinely
+    smaller (the 1/dp masters+slots saving)."""
+    ff = _mlp(argv=["-ll:fsize", "0.007"])  # ~7 KiB/chip: memory-bound
+    dec = ff._update_sharding
+    assert dec["enabled"] and dec["forced"] is None
+    assert dec["reason"] == "memory_bound"
+    p = dec["predicted"]
+    assert p["sharded_mem_bytes"] < p["replicated_mem_bytes"]
+    # the replicated plan is over the cap; the sharded one fits under it
+    assert p["replicated_mem_bytes"] > p["hbm_cap_bytes"]
+    assert p["sharded_mem_bytes"] <= p["hbm_cap_bytes"]
+    # and the executor is actually running the sharded update
+    assert ff.executor.update_specs
+
+
+def test_replicated_wins_when_memory_fits_and_no_overlap():
+    """Auto mode with overlap pricing off and memory comfortable: RS+AG
+    moves the allreduce's exact ring bytes with extra hop latency and no
+    channel to hide on, so the decision stays replicated."""
+    ff = _mlp(argv=["--no-overlap-collectives"])
+    dec = ff._update_sharding
+    assert not dec["enabled"] and dec["forced"] is None
+    assert dec["reason"] == "replicated_cheaper"
+    assert not ff.executor.update_specs
+
+
+def test_cost_model_prices_sharded_state_and_hops():
+    """CostModel.op_cost under update_sharding: per-chip memory shrinks by
+    the 1/shards masters+grad+slots term, update_shards/update_hops are
+    populated, and the RS+AG sync moves the same ring bytes as the
+    allreduce (machine-model identity all_reduce = RS + AG)."""
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.machine_model import machine_model_for_mesh
+    from flexflow_tpu.search.substitution import _logical_assignment
+
+    ff = _mlp(argv=["--no-weight-update-sharding"])
+    node = next(n for n in ff.graph.topo_order()
+                if n.name == "fc1" and n.weight_specs)
+    cm = CostModel(machine_model_for_mesh(ff.mesh), opt_slots=2)
+
+    def price():
+        cm._cache.clear()
+        return cm.op_cost(
+            node, [_logical_assignment(pt) for pt in node.outputs],
+            dict(node.weight_axes),
+            [tuple(d.size for d in pt.shape.dims if not d.is_replica_dim)
+             for pt in node.inputs],
+            [_logical_assignment(pt) for pt in node.inputs])
+
+    rep = price()
+    cm.update_sharding = True
+    sh = price()
+    assert rep.update_shards == 1 and rep.update_hops == 0.0
+    assert rep.update_sync_time == 0.0
+    assert sh.update_shards == 4 and sh.update_hops > 0.0
+    assert sh.update_hop_s > 0.0
+    assert sh.memory < rep.memory
+    # same ring bytes: the sharded RS+AG pair (update_sync_time — the
+    # channel the evaluators may overlap) prices equal to the allreduce
+    # it replaces, and no serial sync remains (every weight sharded here)
+    assert sh.sync_time == 0.0
+    assert sh.update_sync_time == pytest.approx(rep.sync_time, rel=1e-9)
+    # the 1/dp saving is exactly masters+grad+slots going to 1/shards plus
+    # one gathered compute copy, per trainable weight
+    saved = sum(float(np.prod(ws.shape)) * 4 * ((2 + 2) * (1 - 1 / 4) - 1)
+                for ws in node.weight_specs if ws.trainable)
+    assert rep.memory - sh.memory == pytest.approx(saved, rel=1e-6)
+
+
+# ===================================================================
+# strategy report + telemetry surface
+# ===================================================================
+
+def test_strategy_report_surfaces_grad_sync_and_identity(tmp_path):
+    """strategy_report.json under the sharded update: update_sharding /
+    update_shards / grad_sync_s surfaced, the grad RS+AG priced on the
+    overlappable channel (overlap_s covers it), and verify_report_total
+    still reproduces total_predicted_s — the makespan identity extended
+    to the grad-sync channel."""
+    import json
+    import os
+
+    from flexflow_tpu.diagnostics.explain import verify_report_total
+
+    tdir = str(tmp_path / "telemetry")
+    x, y = _data(32)
+    ff = _mlp(argv=["--weight-update-sharding", "--diagnostics",
+                    "--telemetry-dir", tdir])
+    ff.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+    ff.get_telemetry().close()
+
+    with open(os.path.join(tdir, "strategy_report.json")) as f:
+        report = json.load(f)
+    assert report["update_sharding"] is True
+    assert report["update_shards"] == 4
+    assert report["grad_sync_s"] > 0.0
+    synced = [o for o in report["ops"] if o["grad_sync_s"] > 0.0]
+    assert synced, "no op carries grad_sync_s"
+    for o in synced:
+        # the sharded grad sync rides the overlappable channel
+        assert o["overlap_s"] >= o["grad_sync_s"]
+        assert o["sync_s"] == 0.0
+    total = verify_report_total(report)
+    pred = report["total_predicted_s"]
+    assert abs(total - pred) <= 1e-9 + 1e-6 * abs(pred)
+
+
+def test_weight_update_telemetry_events(tmp_path):
+    """Compile emits the weight_update event (shards, buckets, bytes) and
+    per-bucket grad_sync counters; the decision event records why."""
+    import os
+
+    from flexflow_tpu.telemetry import read_jsonl
+
+    tdir = str(tmp_path / "telemetry")
+    x, y = _data(32)
+    ff = _mlp(argv=["--weight-update-sharding", "--telemetry-dir", tdir])
+    ff.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+    ff.get_telemetry().close()
+
+    recs = list(read_jsonl(os.path.join(tdir, "metrics.jsonl")))
+    wu = [r for r in recs if r.get("kind") == "weight_update"]
+    assert wu and wu[0]["shards"] == 4 and wu[0]["buckets"] >= 2
+    assert wu[0]["bytes"] > 0
+    dec = [r for r in recs if r.get("kind") == "weight_update_decision"]
+    assert dec and dec[0]["enabled"] is True
+
+    with open(os.path.join(tdir, "trace.json")) as f:
+        raw = f.read()
+    assert '"grad_sync"' in raw, "no grad_sync span/counter in the trace"
+
+
+# ===================================================================
+# the explicit ring reduce-scatter (bench ablation substrate)
+# ===================================================================
+
+@pytest.mark.parametrize("overlap", [True, False],
+                         ids=["overlapped", "serial"])
+def test_ring_reduce_scatter_matches_reference(overlap):
+    """ring_reduce_scatter (the double-buffered ppermute schedule the
+    sharded grad sync lowers to, and bench.py's microbench subject)
+    computes the exact reduce-scatter: chunk c of the output is the
+    cross-shard sum of every shard's local chunk c."""
+    import jax
+
+    from flexflow_tpu.machine import MeshShape, build_mesh
+    from flexflow_tpu.parallel.ops import ring_reduce_scatter
+
+    if not hasattr(jax.Array, "addressable_shards"):  # pragma: no cover
+        pytest.skip("no shard introspection")
+    mesh = build_mesh(MeshShape((4, 1, 1, 1)))
+    n = 4
+    rs = np.random.RandomState(0)
+    x = rs.randn(n * n * 2, 6).astype(np.float32)
+
+    out = np.asarray(jax.device_get(
+        ring_reduce_scatter(
+            jax.device_put(x), mesh=mesh, axis_name="data",
+            overlap=overlap)))
+
+    # shard i's local block, split into n chunks; output chunk c = Σ_i block_i[c]
+    locals_ = x.reshape(n, x.shape[0] // n, 6)
+    chunk = x.shape[0] // n // n
+    expect = np.zeros((n * chunk, 6), np.float32)
+    for c in range(n):
+        expect[c * chunk:(c + 1) * chunk] = sum(
+            locals_[i][c * chunk:(c + 1) * chunk] for i in range(n))
+    np.testing.assert_allclose(out, expect, rtol=1e-6, atol=1e-6)
+
+
+def test_sharded_update_pipelined_bit_identical():
+    """The sharded update composes with the fused-chunk engine: pinning
+    lives in _train_step_body, which IS the chunked scan body, so
+    --weight-update-sharding --pipeline-steps 4 equals the eager
+    replicated baseline bit-for-bit."""
+    x, y = _data(64)
+
+    rep = _mlp(argv=["--no-weight-update-sharding"])
+    rep.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+
+    sh = _mlp(argv=["--weight-update-sharding", "--pipeline-steps", "4"])
+    sh.fit(x, y, epochs=2, batch_size=8, shuffle=True)
+    assert sh._update_sharding["enabled"] and sh.executor.update_specs
+    _assert_bit_equal(_full_state(rep), _full_state(sh))
+
+
+def test_inference_and_dp1_stay_replicated():
+    """No grad sync → no update sharding: a dp=1 (single-chip) compile
+    auto-decides replicated with reason no_grad_sync even when forced
+    would be legal."""
+    ff = _mlp(mesh=(1, 1, 1, 1), argv=[])
+    dec = ff._update_sharding
+    assert not dec["enabled"] and dec["reason"] == "no_grad_sync"
+    assert not ff.executor.update_specs
