@@ -1,0 +1,79 @@
+"""Source-level sub-checks of the ffcheck pipeline.
+
+Passes 3 and 4 include two checks that live in HOST code, not the PCG:
+coordinator-gated collectives (the multihost-deadlock idiom) and
+donated-buffer reuse after a step call. Both are AST rules (analysis/
+lint.py); this module scopes them to the runtime modules that actually
+call distributed primitives or donated executables, and caches the scan
+per process so the compile gate pays the file parse once, not once per
+compile (the <5% compile-overhead budget).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .findings import Finding
+from .lint import lint_file
+
+# The modules whose host code touches collectives or donated step
+# executables — the blast radius of the two source-level hazards.
+RUNTIME_MODULES = (
+    "model.py",
+    "executor.py",
+    "distributed.py",
+    "engine/pipelined.py",
+    "serving/engine.py",
+    "resilience/manager.py",
+    "resilience/checkpointer.py",
+    "warmstart/manager.py",
+    "diagnostics/drift.py",
+)
+
+# the source-level rules the pass pipeline consumes; scanned together in
+# ONE pass over the module set so the files are parsed once per process
+_SOURCE_RULES = ("coordinator_collective", "donated_reuse")
+
+_cache: list | None = None
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _scan() -> list[Finding]:
+    global _cache
+    if _cache is None:
+        root = package_root()
+        findings: list[Finding] = []
+        for rel in RUNTIME_MODULES:
+            path = os.path.join(root, rel)
+            if not os.path.exists(path):
+                continue
+            findings.extend(lint_file(path, select=_SOURCE_RULES))
+        _cache = findings
+    return _cache
+
+
+def runtime_findings(rules: tuple[str, ...]) -> list[Finding]:
+    """Findings of the source-level rules over the runtime modules,
+    filtered to `rules`. The scan itself runs once per process and is
+    cached (source files do not change under a running compile). Copies
+    are returned with pass_name cleared so the consuming pass attributes
+    them to itself in the report."""
+    import dataclasses
+
+    want = set(rules)
+    return [dataclasses.replace(f, pass_name="")
+            for f in _scan() if f.code in want]
+
+
+def scan_problems() -> list[Finding]:
+    """Scan infrastructure failures (an unparseable runtime module),
+    downgraded to WARNING: the checks did not run — which must be
+    visible — but a verifier-side failure must never abort every
+    compile (the analysis_crash policy). Reported once, by pass 3."""
+    import dataclasses
+
+    return [dataclasses.replace(f, severity="warning", pass_name="")
+            for f in _scan() if f.code == "parse_error"]

@@ -173,6 +173,13 @@ class FFConfig:
     serve_slots: int = 4
     serve_max_seq_len: int = 0
     serve_prefill_chunk: int = 16
+    # static plan verification (analysis/): the ffcheck pass pipeline —
+    # sharding dataflow, memory liveness, collective uniformity,
+    # donation/aliasing — runs at compile on EVERY plan source; errors
+    # abort compile with the findings in strategy_report.json's analysis
+    # section. --no-verify-plan is the escape hatch (findings downgrade
+    # to logged warnings).
+    verify_plan: bool = True
     # eager-loop diagnostics loss fetch cadence: the per-step device_get
     # is a full device drain; K>1 samples it every K-th step and the
     # health/drift rules then see one K-step-AVERAGED record per window
@@ -372,6 +379,8 @@ class FFConfig:
                 self.warmstart_dir = val()
             elif a == "--pipeline-steps":
                 self.pipeline_steps = int(val())
+            elif a == "--no-verify-plan":
+                self.verify_plan = False
             elif a == "--health-sample-every":
                 self.health_sample_every = int(val())
             elif a == "--serve-slots":

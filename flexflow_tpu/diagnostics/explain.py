@@ -309,6 +309,12 @@ def build_strategy_report(model) -> dict:
         "runner_up_evals": flip_evals,
     }
     report["grad_sync_s"] = float(sum(o["grad_sync_s"] for o in ops))
+    analysis = getattr(model, "_analysis", None)
+    if analysis is not None:
+        # ffcheck results (analysis/): the compile gate's findings ride
+        # the report so run_doctor / CI can audit the plan's static
+        # verification next to the makespan identity
+        report["analysis"] = analysis.to_json()
     return report
 
 
@@ -326,6 +332,12 @@ def render_markdown(report: dict) -> str:
         f"- peak per-chip memory: "
         f"{report['peak_memory_bytes'] / 2**20:.1f} MiB",
     ]
+    if report.get("analysis"):
+        a = report["analysis"]
+        lines.append(
+            f"- static verification (ffcheck): {a['errors']} error(s), "
+            f"{a['warnings']} warning(s) across "
+            f"{', '.join(a['passes_run'])}")
     if report.get("update_sharding"):
         lines.append(
             f"- weight-update sharding: ON — masters + optimizer slots "

@@ -135,6 +135,9 @@ class FFModel:
         # with the grad sync as an overlappable reduce-scatter; recorded
         # in checkpoint manifests + strategy_report.json
         self._update_sharding = None
+        # ffcheck result (analysis.AnalysisResult) of the compile gate —
+        # strategy_report.json surfaces it as its `analysis` section
+        self._analysis = None
 
     # ================================================== tensor creation
 
@@ -1172,6 +1175,16 @@ class FFModel:
             enabled=self._update_sharding["enabled"],
             shards=self._update_sharding["shards"],
             reason=self._update_sharding.get("reason", ""))
+        # --- ffcheck compile gate (analysis/): static verification of the
+        # materialized plan — sharding dataflow, memory liveness,
+        # collective uniformity, donation/aliasing — on EVERY plan source
+        # (all six adoption paths funnel through this point), BEFORE
+        # init_variables touches device memory, so a predicted OOM or an
+        # invalid sharding fails fast with a structured report instead of
+        # a device error. Errors raise unless --no-verify-plan.
+        from .analysis import verify_plan
+
+        verify_plan(self, cost_model=search_cost_model)
         self._rng = jax.random.key(self.config.seed)
         self._params, self._state = self.executor.init_variables(self._rng)
         # optimizer slots inherit the (possibly update-sharded) param

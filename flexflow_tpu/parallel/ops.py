@@ -196,6 +196,21 @@ for _ot in (
     )
 
 
+def ring_permutation(n: int) -> list:
+    """THE ring-rotation schedule: shard i sends to (i+1) mod n — a
+    complete bijection on range(n). Every ring body (ring attention's KV
+    rotation, the decomposed allgather-matmul, the ring reduce-scatter,
+    the ppermute hop calibrator) builds its ppermute permutation through
+    this ONE helper, and the ffcheck collective-uniformity pass
+    (analysis/collectives.py) validates exactly this function's output
+    for every ring the plan will run — a partial or duplicated
+    permutation would make ppermute zero-fill the missing destinations
+    and silently corrupt the ring. (The pipeline fill/drain shift in
+    parallel/pipeline.py is deliberately NOT a ring and does not use
+    this.)"""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
 # ------------------------------------------------- decomposed collective matmul
 # The async/overlapped twin of the tp all_gather→matmul pairs GSPMD inserts
 # when a feature-sharded activation feeds an op expecting the full feature
@@ -221,7 +236,7 @@ def _ag_matmul_local(x_blk, w, *, axis_name: str, n: int, overlap: bool):
     idx = jax.lax.axis_index(axis_name)
     k_loc = x_blk.shape[-1]
     acc = jnp.zeros(x_blk.shape[:-1] + (w.shape[-1],), jnp.float32)
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_permutation(n)
     for step in range(n):
         x_nxt = None
         if overlap and step < n - 1:
@@ -389,7 +404,7 @@ def _rs_local(x, *, axis_name: str, n: int, overlap: bool):
     idx = jax.lax.axis_index(axis_name)
     m = x.shape[0]
     chunk = m // n
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_permutation(n)
 
     def take(src, c):
         return jax.lax.dynamic_slice_in_dim(src, c * chunk, chunk, axis=0)

@@ -84,9 +84,11 @@ def _ring_local(q, k, v, *, axis_name: str, n: int, causal: bool,
     idx = jax.lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
 
+    from .ops import ring_permutation
+
     o = jnp.zeros((b, h, s_loc, d), jnp.float32)
     lse = jnp.full((b, h, s_loc), -jnp.inf, jnp.float32)
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_permutation(n)
     k_blk, v_blk = k, v
 
     for step in range(n):

@@ -113,78 +113,20 @@ class Strategy:
 
         `Strategy.load` / `--import-strategy` historically checked only the
         file `version`, so a plan exported from a different model or mesh
-        silently degraded to data parallel node by node. This validator is
-        the shared gate: the import path raises on failure; the warm-start
-        plan cache treats a failure as a miss and re-searches. Checks:
-        unknown node names, out-of-range output indices / rank mismatches,
-        unknown weight names, mesh axes absent from the mesh, and sharded
-        dims not divisible by their axes' total degree."""
-        axis_sizes = {k: int(v) for k, v in dict(mesh.shape).items()}
-        nodes = {n.name: n for n in graph.topo_order()}
-        problems: list[str] = []
+        silently degraded to data parallel node by node. Delegates to the
+        ffcheck sharding verifier (analysis/sharding.py) — the ONE shared
+        gate — so the import path, the warm-start plan cache, and
+        checkpoint plan adoption inherit every verifier check, including
+        the one this method historically MISSED: the same mesh axis used
+        on two different dims of one assignment (an invalid NamedSharding
+        that only exploded at device_put time). Checks: unknown node
+        names, out-of-range output indices / rank mismatches, unknown
+        weight names, mesh axes absent from the mesh, per-assignment axis
+        reuse, oversharded dims, and sharded dims not divisible by their
+        axes' total degree."""
+        from ..analysis import verify_strategy
 
-        def check_axes(where: str, axes, dim_size: int | None):
-            degree = 1
-            for ax in axes:
-                size = axis_sizes.get(ax)
-                if size is None:
-                    problems.append(
-                        f"{where}: mesh axis {ax!r} not in mesh "
-                        f"{sorted(axis_sizes)}")
-                    return
-                degree *= size
-            if dim_size is not None and degree > 1 \
-                    and dim_size % degree != 0:
-                problems.append(
-                    f"{where}: dim of size {dim_size} not divisible by "
-                    f"total sharding degree {degree} over {tuple(axes)}")
-
-        for name, ov in self.overrides.items():
-            node = nodes.get(name)
-            if node is None:
-                problems.append(
-                    f"node {name!r} not in this graph (plan exported from "
-                    f"a different model?)")
-                continue
-            for idx, assignment in ov.get("outputs", {}).items():
-                if idx >= len(node.outputs):
-                    problems.append(
-                        f"{name}: output index {idx} out of range "
-                        f"({len(node.outputs)} outputs)")
-                    continue
-                shape = node.outputs[idx].shape.logical_shape
-                if len(assignment) != len(shape):
-                    problems.append(
-                        f"{name}: output {idx} assignment has "
-                        f"{len(assignment)} dims, tensor has {len(shape)}")
-                    continue
-                for d, axes in enumerate(assignment):
-                    check_axes(f"{name}: output {idx} dim {d}", axes,
-                               shape[d])
-            declared = {ws.name: ws for ws in node.weight_specs}
-            for wname, spec in ov.get("weights", {}).items():
-                ws = declared.get(wname)
-                if ws is None:
-                    problems.append(
-                        f"{name}: no weight named {wname!r} "
-                        f"(has {sorted(declared)})")
-                    continue
-                if len(spec) > len(ws.shape):
-                    problems.append(
-                        f"{name}: weight {wname!r} spec has {len(spec)} "
-                        f"dims, weight has {len(ws.shape)}")
-                    continue
-                for d in range(len(spec)):
-                    e = spec[d]
-                    if e is None:
-                        continue
-                    axes = e if isinstance(e, tuple) else (e,)
-                    check_axes(f"{name}: weight {wname!r} dim {d}", axes,
-                               ws.shape[d])
-        if problems:
-            raise ValueError(
-                "strategy does not apply to this graph/mesh:\n  "
-                + "\n  ".join(problems))
+        verify_strategy(self.overrides, graph, mesh)
 
     def save(self, path: str):
         import json

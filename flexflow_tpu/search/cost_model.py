@@ -603,7 +603,10 @@ class CostModel:
                 ts = []
                 for _ in range(3):
                     t0 = time.perf_counter()
-                    float(jax.device_get(loop(flat0, jnp.int32(n))))
+                    # the fetch IS the measurement (device_get is the
+                    # only reliable sync on the tunneled backend, see
+                    # module docstring)
+                    float(jax.device_get(loop(flat0, jnp.int32(n))))  # fflint: ok host_sync_in_loop
                     ts.append(time.perf_counter() - t0)
                 return statistics.median(ts)
 
@@ -770,7 +773,9 @@ class CostModel:
         n = dict(mesh.shape).get(axis, 1)
         if n <= 1:
             raise ValueError(f"axis {axis!r} has size {n}")
-        perm = [(i, (i + 1) % n) for i in range(n)]
+        from ..parallel.ops import ring_permutation
+
+        perm = ring_permutation(n)
         elems = max(128, nbytes // 4)
         x = jnp.zeros((n * elems,), jnp.float32)
         spec = P(axis)
@@ -795,7 +800,9 @@ class CostModel:
             ts = []
             for _ in range(3):
                 t0 = time.perf_counter()
-                float(jax.device_get(run(x, jnp.int32(reps))))
+                # the fetch IS the measurement (same rationale as
+                # calibrate's timing loop above)
+                float(jax.device_get(run(x, jnp.int32(reps))))  # fflint: ok host_sync_in_loop
                 ts.append(time.perf_counter() - t0)
             return statistics.median(ts)
 

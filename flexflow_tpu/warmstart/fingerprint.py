@@ -174,8 +174,12 @@ def calibration_fingerprint(cost_model, graph) -> str:
     # collective-hop entries (reserved OP_NOOP keys written by
     # CostModel.calibrate_collectives): they price the sp ring traffic
     # via collective_rotate, so a refreshed hop measurement must change
-    # the plan address like any other calibration the search consumed
-    for key, cal in cost_model._calibration.items():
+    # the plan address like any other calibration the search consumed.
+    # Iteration is explicitly sorted (fflint unsorted_dict_hash): dict
+    # order is insertion order, which differs between a process that
+    # MEASURED the entries and one that LOADED them from the DB
+    for key, cal in sorted(cost_model._calibration.items(),
+                           key=lambda kv: serialize_key(kv[0])):
         name = key[1] if len(key) > 1 else ""
         if isinstance(name, str) and name.startswith("__collective_"):
             entries.append([serialize_key(key), repr(cal[0]), repr(cal[1])])

@@ -153,8 +153,12 @@ def _decode_validated_plan(model, graph, strategy_json, mesh_axes_raw):
     mesh-shape-searched plan carries its winning factorization; an empty
     mesh_axes means the current mesh). The ONE decode+validate gate both
     restore paths — plan cache and checkpoint manifest — go through.
-    Raises ValueError/KeyError/TypeError/AttributeError on anything stale
-    or malformed; callers convert that to a miss."""
+    `Strategy.validate` delegates to the full ffcheck sharding verifier
+    (analysis.verify_strategy), so cache/checkpoint/import adoption all
+    inherit every verifier check — axis reuse, oversharding,
+    indivisibility, unknown nodes/weights/axes. Raises ValueError/
+    KeyError/TypeError/AttributeError on anything stale or malformed;
+    callers convert that to a miss + re-search, never a crash."""
     from ..parallel.strategies import Strategy
     from ..search.mesh_search import MeshSpec
 

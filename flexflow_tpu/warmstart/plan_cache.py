@@ -55,6 +55,20 @@ class PlanCache:
                 "warmstart: plan cache entry %s malformed/stale — "
                 "treating as a miss", path)
             return None
+        # graph-free ffcheck precheck: per-assignment mesh-axis reuse is
+        # an invalid NamedSharding detectable from the JSON alone — a
+        # poisoned/hand-edited entry reads as a miss here, before the
+        # full verifier (Strategy.validate → analysis.verify_strategy)
+        # sees it against the graph downstream
+        from ..analysis.sharding import strategy_json_problems
+
+        problems = strategy_json_problems(entry["strategy"])
+        if problems:
+            fflog.warning(
+                "warmstart: plan cache entry %s fails static "
+                "verification (%s) — treating as a miss",
+                path, "; ".join(str(p) for p in problems[:3]))
+            return None
         return entry
 
     def store(self, fingerprint: str, strategy_json: dict,

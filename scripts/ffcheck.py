@@ -1,0 +1,426 @@
+"""ffcheck: standalone static plan verification — the CI gate.
+
+Three jobs (docs/analysis.md):
+
+1. **Six-source sweep** (`--sources all`, the default): compile one
+   small transformer LM through every plan-adoption path — search,
+   cache, checkpoint, import, manual, default — and assert the ffcheck
+   compile gate ran on each with ZERO errors. This pins the acceptance
+   property "ffcheck runs on all six plan sources at compile time".
+
+2. **Corruption self-test** (`--self-test`, also on by default): the
+   plan-mutation fuzzer's corruption matrix, run end-to-end through the
+   pass pipeline — inject each class into a real searched plan
+   (axis reuse, dropped parallel op → implicit reshard, oversharded
+   dim, non-bijective ring permutation, donated-then-reused buffer,
+   coordinator-only collective) and assert the verifier reports exactly
+   that finding class.
+
+3. **Smoke suites** (`--suite longcontext`, `--suite wus`): compile the
+   long-context ring plan and the memory-constrained weight-update-
+   sharding plan (the same configs the dedicated CI smokes run) and
+   assert they verify clean — the ring bijection check really sees the
+   sp plan's rings, and the two-keyed OOM rule does NOT fire on a plan
+   the update-sharding decision made fit.
+
+Writes a machine-readable report with `--report OUT.json` (uploaded as a
+CI artifact). Exits nonzero on any violated assertion.
+
+Usage: python scripts/ffcheck.py [--report OUT.json]
+       [--sources all|s1,s2,...] [--self-test] [--no-self-test]
+       [--suite longcontext] [--suite wus]
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# virtual 8-device CPU mesh, exactly like tests/conftest.py
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+ALL_SOURCES = ("search", "cache", "checkpoint", "import", "manual",
+               "default")
+
+# progressive report state: fail() flushes whatever has been collected
+# so far, so the CI artifact exists (with the failure recorded) for RED
+# runs too — that is when a machine-readable report matters most
+_REPORT: dict = {"kind": "ffcheck_report", "ok": False}
+_REPORT_PATH = ""
+
+
+def _write_report():
+    if not _REPORT_PATH:
+        return
+    d = os.path.dirname(os.path.abspath(_REPORT_PATH))
+    os.makedirs(d, exist_ok=True)
+    with open(_REPORT_PATH, "w") as f:
+        json.dump(_REPORT, f, indent=1)
+    print(f"ffcheck: report written to {_REPORT_PATH}")
+
+
+def fail(msg: str):
+    print(f"ffcheck: FAIL: {msg}", file=sys.stderr)
+    _REPORT["failure"] = msg
+    _write_report()
+    sys.exit(1)
+
+
+def _lm(config, seq=16, ring=False):
+    from flexflow_tpu import FFModel
+    from flexflow_tpu.models import TransformerLMConfig, build_transformer_lm
+
+    ff = FFModel(config)
+    cfg = TransformerLMConfig(
+        vocab_size=64, hidden_size=32, num_heads=2, num_layers=1,
+        sequence_length=seq,
+        attention_impl="ring" if ring else "xla")
+    build_transformer_lm(ff, cfg, batch_size=4)
+    return ff, cfg
+
+
+def _config(**kw):
+    from flexflow_tpu import FFConfig
+
+    cfg = FFConfig()
+    cfg.mesh_axis_sizes = (2, 4, 1, 1)
+    cfg.batch_size = 4
+    cfg.search_budget = 6
+    cfg.enable_parameter_parallel = True
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _compile(ff):
+    from flexflow_tpu import LossType, SGDOptimizer
+
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def _check_clean(ff, source: str) -> dict:
+    res = getattr(ff, "_analysis", None)
+    if res is None:
+        fail(f"source {source}: compile gate did not run "
+             f"(model._analysis is None)")
+    if ff._plan_source != source:
+        fail(f"expected plan_source {source!r}, got "
+             f"{ff._plan_source!r}")
+    errs = res.errors()
+    if errs:
+        fail(f"source {source}: plan verification errors: "
+             f"{[str(f) for f in errs[:5]]}")
+    print(f"ffcheck: source {source:10s} — clean "
+          f"({len(res.findings)} finding(s), "
+          f"{res.elapsed_s * 1e3:.0f} ms)")
+    entry = {"plan_source": source, **res.summary(),
+             "elapsed_s": res.elapsed_s}
+    _REPORT.setdefault("sources", []).append(entry)
+    return entry
+
+
+def run_sources(workdir: str, sources) -> list[dict]:
+    from flexflow_tpu.parallel.strategies import (
+        Strategy,
+        megatron_transformer,
+    )
+
+    out = []
+    plan_path = os.path.join(workdir, "plan.json")
+
+    if "search" in sources or "import" in sources:
+        ff = _compile(_lm(_config())[0])
+        if "search" in sources:
+            out.append(_check_clean(ff, "search"))
+        Strategy(ff._strategy or {}).save(plan_path)
+
+    if "cache" in sources:
+        ws = os.path.join(workdir, "warmstart")
+        _compile(_lm(_config(warmstart_dir=ws))[0])  # cold: populates
+        ff = _compile(_lm(_config(warmstart_dir=ws))[0])  # warm: hit
+        out.append(_check_clean(ff, "cache"))
+
+    if "checkpoint" in sources:
+        ck = os.path.join(workdir, "ckpt")
+        ff, cfg = _lm(_config(checkpoint_dir=ck, checkpoint_every=1,
+                              auto_resume=True))
+        _compile(ff)
+        rs = np.random.RandomState(0)
+        n = 8
+        X = {"tokens": rs.randint(
+                0, cfg.vocab_size,
+                (n, cfg.sequence_length)).astype(np.int32),
+             "positions": np.tile(np.arange(cfg.sequence_length,
+                                            dtype=np.int32), (n, 1))}
+        Y = rs.randint(0, cfg.vocab_size,
+                       (n, cfg.sequence_length, 1)).astype(np.int32)
+        ff.fit(X, Y, epochs=1, batch_size=4, shuffle=False,
+               verbose=False)
+        ff2 = _compile(_lm(_config(checkpoint_dir=ck, checkpoint_every=1,
+                                   auto_resume=True))[0])
+        out.append(_check_clean(ff2, "checkpoint"))
+
+    if "import" in sources:
+        ff = _compile(_lm(_config(import_strategy_file=plan_path))[0])
+        out.append(_check_clean(ff, "import"))
+
+    if "manual" in sources:
+        ff, _ = _lm(_config(search_budget=0,
+                            enable_parameter_parallel=False))
+        ff.set_strategy(megatron_transformer(ff))
+        _compile(ff)
+        out.append(_check_clean(ff, "manual"))
+
+    if "default" in sources:
+        ff = _compile(_lm(_config(search_budget=0,
+                                  enable_parameter_parallel=False))[0])
+        out.append(_check_clean(ff, "default"))
+    return out
+
+
+# ---------------------------------------------------------------- fuzzer
+
+_DONATED_SNIPPET = """
+def fit_loop(self, batch):
+    new = step_fn(self._params, self._state, self._opt_slots,
+                  self._step, self._counters, rng, batch)
+    loss = float(self._params["head"]["kernel"].sum())
+    return new, loss
+"""
+
+_COORD_SNIPPET = """
+def save_plan(payload):
+    from flexflow_tpu.distributed import barrier, is_coordinator
+    if is_coordinator():
+        write(payload)
+        barrier("plan-committed")
+"""
+
+
+def run_self_test(workdir: str) -> list[dict]:
+    """Inject each corruption class into a real plan / source snippet and
+    assert the verifier reports exactly that class."""
+    from flexflow_tpu.analysis import (
+        context_for_model,
+        lint,
+        run_analysis,
+    )
+    from flexflow_tpu.analysis.sharding import _LAYOUT_PRESERVING
+    from flexflow_tpu.parallel import ops as par_ops
+    from flexflow_tpu.parallel.strategies import (
+        sequence_parallel_attention,
+    )
+
+    results = []
+
+    def check(klass: str, codes, expect: str):
+        if expect not in codes:
+            fail(f"self-test {klass}: expected finding {expect!r}, "
+                 f"got {sorted(set(codes))}")
+        print(f"ffcheck: self-test {klass:22s} — caught ({expect})")
+        results.append({"class": klass, "finding": expect})
+        _REPORT.setdefault("self_test", []).append(
+            {"class": klass, "finding": expect})
+
+    ff = _compile(_lm(_config())[0])
+    ctx = context_for_model(ff)
+    clean = run_analysis(ff.graph, ff.mesh, ctx)
+    if clean.errors():
+        fail(f"self-test baseline not clean: "
+             f"{[str(f) for f in clean.errors()]}")
+
+    def mutate(node_pred, new_assign_fn, expect, klass):
+        node = next(n for n in ff.graph.topo_order() if node_pred(n))
+        pt = node.outputs[0]
+        saved = pt.axis_assignment
+        pt.axis_assignment = new_assign_fn(pt)
+        try:
+            res = run_analysis(ff.graph, ff.mesh, ctx)
+        finally:
+            pt.axis_assignment = saved
+        check(klass, [f.code for f in res.findings], expect)
+
+    # 1) axis reuse: same mesh axis on two dims of one assignment
+    mutate(lambda n: len(n.outputs) > 0 and len(n.outputs[0].shape.dims) >= 2,
+           lambda pt: (("data",), ("data",))
+           + tuple(() for _ in pt.shape.dims[2:]),
+           "axis_reuse", "axis_reuse")
+
+    # 2) dropped parallel op: a layout-preserving consumer loses its
+    # producer's sharding — the reshard GSPMD inserts is implicit now
+    def _ew_with_sharded_producer(n):
+        if n.op_type not in _LAYOUT_PRESERVING or not n.inputs:
+            return False
+        return any(a for a in n.inputs[0].axis_assignment)
+
+    mutate(_ew_with_sharded_producer,
+           lambda pt: tuple(() for _ in pt.shape.dims),
+           "implicit_reshard", "dropped_parallel_op")
+
+    # 3) oversharded dim: more shards than elements
+    mutate(lambda n: (len(n.outputs) > 0
+                      and not n.outputs[0].shape.dims[0].is_replica_dim
+                      and n.outputs[0].shape.dims[0].size < 8),
+           lambda pt: (("data", "model"),)
+           + tuple(() for _ in pt.shape.dims[1:]),
+           "overshard", "oversharded_dim")
+
+    # 4) non-bijective ring permutation: corrupt the ONE shared schedule
+    # builder every ring body uses, on a plan that actually runs a ring
+    ring_cfg = _config(search_budget=0, enable_parameter_parallel=False)
+    ring_cfg.mesh_axis_sizes = (2, 1, 1, 2)
+    ring_ff, _ = _lm(ring_cfg, seq=16, ring=True)
+    ring_ff.set_strategy(sequence_parallel_attention(ring_ff))
+    _compile(ring_ff)
+    rctx = context_for_model(ring_ff)
+    good = par_ops.ring_permutation
+    par_ops.ring_permutation = lambda n: good(n)[:-1]  # drop a pair
+    try:
+        res = run_analysis(ring_ff.graph, ring_ff.mesh, rctx)
+    finally:
+        par_ops.ring_permutation = good
+    check("non_bijective_permutation",
+          [f.code for f in res.findings], "bad_permutation")
+
+    # 5) donated-then-reused buffer (source-level)
+    codes = [f.code for f in lint.lint_source(
+        _DONATED_SNIPPET, "snippet.py", select=("donated_reuse",))]
+    check("donated_then_reused", codes, "donated_reuse")
+
+    # 6) coordinator-only collective (source-level)
+    codes = [f.code for f in lint.lint_source(
+        _COORD_SNIPPET, "snippet.py",
+        select=("coordinator_collective",))]
+    check("coordinator_collective", codes, "coordinator_collective")
+    return results
+
+
+# ---------------------------------------------------------------- suites
+
+def run_suite(name: str) -> dict:
+    from flexflow_tpu import FFConfig
+
+    if name == "longcontext":
+        # the longcontext_smoke config: ring LM on a seq=4 mesh, search
+        # on — the bijection check must see the sp plan's rings
+        cfg = _config(search_budget=4, enable_parameter_parallel=False)
+        cfg.mesh_axis_sizes = (1, 1, 1, 4)
+        cfg.enable_sample_parallel = True
+        cfg.batch_size = 2
+        ff, _ = _lm(cfg, seq=256, ring=True)
+        _compile(ff)
+        res = ff._analysis
+        if res is None or res.errors():
+            fail(f"suite longcontext: verification errors "
+                 f"{[str(f) for f in (res.errors() if res else [])]}")
+        msgs = " ".join(f.message for f in res.findings)
+        if "ring schedule" not in msgs and "ring attention" not in msgs:
+            fail("suite longcontext: collective pass saw no ring "
+                 "schedule in the sp plan")
+    elif name == "wus":
+        # the wus_smoke config: dp=4, HBM capped below the replicated
+        # update — the auto-sharded plan must verify clean (the
+        # two-keyed OOM rule must NOT fire on a plan the update-sharding
+        # decision made fit)
+        from flexflow_tpu import FFModel, LossType, SGDOptimizer
+        from flexflow_tpu.models import (
+            TransformerLMConfig,
+            build_transformer_lm,
+        )
+
+        cfg = FFConfig()
+        cfg.mesh_axis_sizes = (4, 1, 1, 1)
+        cfg.batch_size = 4
+        cfg.device_mem = 1.5 * 1024 * 1024
+        ff = FFModel(cfg)
+        c = TransformerLMConfig(vocab_size=128, hidden_size=64,
+                                num_heads=2, num_layers=2,
+                                sequence_length=32)
+        build_transformer_lm(ff, c, batch_size=4)
+        ff.compile(optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+                   loss_type=LossType
+                   .LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        res = ff._analysis
+        if res is None or res.errors():
+            fail(f"suite wus: verification errors "
+                 f"{[str(f) for f in (res.errors() if res else [])]}")
+        if not (ff._update_sharding or {}).get("enabled"):
+            fail("suite wus: update sharding not selected — the suite "
+                 "no longer exercises the sharded-update memory path")
+    else:
+        fail(f"unknown suite {name!r} (have longcontext, wus)")
+    print(f"ffcheck: suite {name} — clean")
+    _REPORT.setdefault("suites", []).append({"suite": name, "ok": True})
+    return {"suite": name, "ok": True}
+
+
+def main():
+    argv = sys.argv[1:]
+    report_path = ""
+    sources = list(ALL_SOURCES)
+    self_test = True
+    suites = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--report":
+            i += 1
+            report_path = argv[i]
+        elif a == "--sources":
+            i += 1
+            sources = ([] if argv[i] == "none"
+                       else list(ALL_SOURCES) if argv[i] == "all"
+                       else [s.strip() for s in argv[i].split(",")])
+            unknown = set(sources) - set(ALL_SOURCES)
+            if unknown:
+                fail(f"unknown sources {sorted(unknown)}")
+        elif a == "--self-test":
+            self_test = True
+        elif a == "--no-self-test":
+            self_test = False
+        elif a == "--suite":
+            i += 1
+            suites.append(argv[i])
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return
+        else:
+            fail(f"unknown flag {a!r}")
+        i += 1
+    sys.argv = [sys.argv[0]]  # FFConfig must not parse ffcheck's flags
+
+    global _REPORT_PATH
+    _REPORT_PATH = report_path
+    workdir = tempfile.mkdtemp(prefix="ffcheck-")
+    try:
+        if sources:
+            run_sources(workdir, sources)
+        if self_test:
+            run_self_test(workdir)
+        if suites:
+            for s in suites:
+                run_suite(s)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    _REPORT["ok"] = True
+    _write_report()
+    print("ffcheck: OK")
+
+
+if __name__ == "__main__":
+    main()

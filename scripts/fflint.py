@@ -1,0 +1,78 @@
+"""fflint: the repo-wide JAX-hazard lint (analysis/lint.py rules).
+
+Rules — each encodes a bug class a past PR fixed by hand (docs/
+analysis.md has the catalog):
+
+  host_sync_in_loop        jax.device_get inside a for/while loop, not
+                           behind a telemetry/diagnostics gate
+  unsorted_dict_hash       dict iteration feeding a fingerprint/hash
+                           without sorted(...)
+  global_rng               process-global np.random.* / random.* calls
+  time_in_trace            time.*/RNG calls inside a traced function
+  coordinator_collective   a collective inside an is_coordinator() branch
+  donated_reuse            donated step buffer read host-side after the
+                           call without rebinding
+
+Suppression: trailing `# fflint: ok [codes]` on the line or its `def`.
+
+Usage: python scripts/fflint.py [paths...] [--select r1,r2]
+Default paths: flexflow_tpu/ scripts/ bench.py (tests are exempt — they
+synthesize hazards on purpose). Exits 1 on ANY finding: CI runs this
+with the repo required clean.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_PATHS = ("flexflow_tpu", "scripts", "bench.py")
+
+
+def main() -> int:
+    from flexflow_tpu.analysis.lint import ALL_RULES, lint_paths
+
+    argv = sys.argv[1:]
+    select = None
+    paths = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--select":
+            i += 1
+            select = tuple(r.strip() for r in argv[i].split(",")
+                           if r.strip())
+            unknown = set(select) - set(ALL_RULES)
+            if unknown:
+                print(f"fflint: unknown rule(s) {sorted(unknown)} "
+                      f"(have {ALL_RULES})", file=sys.stderr)
+                return 2
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        else:
+            paths.append(a)
+        i += 1
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not paths:
+        paths = [os.path.join(root, p) for p in DEFAULT_PATHS]
+    paths = [p for p in paths if os.path.exists(p)]
+
+    findings = lint_paths(paths, select=select)
+    for f in findings:
+        print(f)
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = len(findings) - n_err
+    if findings:
+        print(f"fflint: {n_err} error(s), {n_warn} warning(s) — "
+              f"fix or suppress with '# fflint: ok <rule>'",
+              file=sys.stderr)
+        return 1
+    print(f"fflint: clean ({len(paths)} path(s), rules: "
+          f"{', '.join(select or ALL_RULES)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

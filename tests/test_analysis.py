@@ -1,0 +1,619 @@
+"""ffcheck static-analysis tests (analysis/, docs/analysis.md).
+
+The acceptance surface of the compile gate: a plan-mutation fuzzer
+injects each corruption class into a real searched plan (axis reuse,
+dropped parallel op, oversharded dim, non-bijective ring permutation,
+donated-then-reused buffer, coordinator-only collective) and asserts
+ffcheck reports exactly that class; clean plans verify with zero errors
+on every plan source; the memory-liveness pass fails a predicted OOM
+before device allocation with `--no-verify-plan` as the escape hatch;
+the fflint rules catch their synthetic hazards AND pass clean over the
+repo (the CI invariant); and the donation registry cross-checks against
+executor.py's own AST.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+
+def _config(argv):
+    sys.argv = ["test"] + list(argv)
+    from flexflow_tpu import FFConfig
+
+    config = FFConfig()
+    config.batch_size = 4
+    return config
+
+
+def _lm(config, seq=16, ring=False, layers=1):
+    from flexflow_tpu import FFModel
+    from flexflow_tpu.models import TransformerLMConfig, build_transformer_lm
+
+    ff = FFModel(config)
+    cfg = TransformerLMConfig(
+        vocab_size=64, hidden_size=32, num_heads=2, num_layers=layers,
+        sequence_length=seq,
+        attention_impl="ring" if ring else "xla")
+    build_transformer_lm(ff, cfg, batch_size=4)
+    return ff, cfg
+
+
+def _compile(ff, momentum=0.0):
+    from flexflow_tpu import LossType, SGDOptimizer
+
+    ff.compile(optimizer=SGDOptimizer(lr=0.01, momentum=momentum),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+@pytest.fixture(scope="module")
+def searched():
+    """One searched compile shared by the fuzzer tests (mutations always
+    restore what they touched)."""
+    ff, _ = _lm(_config(["--mesh", "2,4,1,1", "--budget", "6",
+                         "--enable-parameter-parallel"]))
+    return _compile(ff)
+
+
+@pytest.fixture(scope="module")
+def ring_model():
+    """Manual sequence-parallel ring-attention plan on a seq=2 mesh."""
+    from flexflow_tpu.parallel.strategies import sequence_parallel_attention
+
+    ff, _ = _lm(_config(["--mesh", "2,1,1,2"]), ring=True)
+    ff.set_strategy(sequence_parallel_attention(ff))
+    return _compile(ff)
+
+
+def _analyze(ff):
+    from flexflow_tpu.analysis import context_for_model, run_analysis
+
+    return run_analysis(ff.graph, ff.mesh, context_for_model(ff))
+
+
+# ===================================================== unit: primitives
+
+
+def test_permutation_checker():
+    from flexflow_tpu.analysis.collectives import check_permutation
+    from flexflow_tpu.parallel.ops import ring_permutation
+
+    assert check_permutation(ring_permutation(4), 4) == []
+    # dropped pair, duplicated destination, out-of-range
+    assert [f.code for f in
+            check_permutation(ring_permutation(4)[:-1], 4)] \
+        == ["bad_permutation"]
+    assert check_permutation([(0, 1), (1, 1), (2, 0), (3, 2)], 4)
+    assert check_permutation([(0, 1), (1, 2), (2, 3), (3, 9)], 4)
+
+
+def test_assignment_problems_matrix():
+    from flexflow_tpu.analysis.sharding import assignment_problems
+
+    axes = {"data": 2, "model": 4}
+    ok = assignment_problems((("data",), ("model",)), (8, 8), axes, "t")
+    assert ok == []
+    reuse = assignment_problems((("data",), ("data",)), (8, 8), axes, "t")
+    assert [f.code for f in reuse] == ["axis_reuse"]
+    indiv = assignment_problems((("model",), ()), (6, 8), axes, "t")
+    assert [f.code for f in indiv] == ["indivisible_dim"]
+    over = assignment_problems(((("data"), ("model")), ()), (2, 8),
+                               axes, "t")
+    assert "overshard" in [f.code for f in over]
+    unknown = assignment_problems((("ghost",),), (8,), axes, "t")
+    assert [f.code for f in unknown] == ["unknown_axis"]
+
+
+def test_validate_rejects_axis_reuse(searched):
+    """The satellite regression: Strategy.validate historically accepted
+    an assignment using one mesh axis on two different dims — an invalid
+    NamedSharding that only exploded at device_put. It now delegates to
+    the verifier and rejects it."""
+    from flexflow_tpu.parallel.strategies import Strategy
+
+    node = next(n for n in searched.graph.topo_order()
+                if n.outputs and len(n.outputs[0].shape.dims) >= 2)
+    nd = len(node.outputs[0].shape.dims)
+    bad = Strategy()
+    bad.set_output(node.name, 0,
+                   (("data",), ("data",)) + ((),) * (nd - 2))
+    with pytest.raises(ValueError, match="axis_reuse|at most once"):
+        bad.validate(searched.graph, searched.mesh)
+
+
+def test_strategy_json_precheck():
+    """The plan cache rejects a poisoned entry from the JSON alone."""
+    from flexflow_tpu.analysis.sharding import strategy_json_problems
+
+    clean = {"nodes": {"l": {"outputs": {"0": [["data"], []]},
+                             "weights": {}}}}
+    assert strategy_json_problems(clean) == []
+    poisoned = {"nodes": {"l": {"outputs": {"0": [["data"], ["data"]]},
+                                "weights": {"kernel":
+                                            ["model", "model"]}}}}
+    codes = [f.code for f in strategy_json_problems(poisoned)]
+    assert codes == ["axis_reuse", "axis_reuse"]
+
+
+# ================================================= the corruption fuzzer
+
+
+def _mutate_and_run(ff, node_pred, new_assign):
+    node = next(n for n in ff.graph.topo_order() if node_pred(n))
+    pt = node.outputs[0]
+    saved = pt.axis_assignment
+    pt.axis_assignment = new_assign(pt)
+    try:
+        return _analyze(ff)
+    finally:
+        pt.axis_assignment = saved
+
+
+def test_fuzzer_clean_baseline(searched):
+    res = _analyze(searched)
+    assert res.ok, [str(f) for f in res.errors()]
+    assert res.passes_run == ["sharding_dataflow", "memory_liveness",
+                              "collective_uniformity",
+                              "donation_aliasing"]
+
+
+def test_fuzzer_axis_reuse(searched):
+    res = _mutate_and_run(
+        searched,
+        lambda n: n.outputs and len(n.outputs[0].shape.dims) >= 2,
+        lambda pt: (("data",), ("data",))
+        + tuple(() for _ in pt.shape.dims[2:]))
+    assert [f.code for f in res.errors()] == ["axis_reuse"]
+
+
+def test_fuzzer_dropped_parallel_op(searched):
+    """Stripping a layout-preserving consumer's sharding while its
+    producer stays sharded = the reshard a dropped parallel op leaves
+    implicit; ffcheck flags the edge."""
+    from flexflow_tpu.analysis.sharding import _LAYOUT_PRESERVING
+
+    res = _mutate_and_run(
+        searched,
+        lambda n: (n.op_type in _LAYOUT_PRESERVING and n.inputs
+                   and any(a for a in n.inputs[0].axis_assignment)),
+        lambda pt: tuple(() for _ in pt.shape.dims))
+    hits = res.by_code("implicit_reshard")
+    assert hits, [str(f) for f in res.findings]
+    assert res.ok  # a warning, not an error: priced plans may reshard
+
+
+def test_fuzzer_oversharded_dim(searched):
+    res = _mutate_and_run(
+        searched,
+        lambda n: (n.outputs
+                   and not n.outputs[0].shape.dims[0].is_replica_dim
+                   and n.outputs[0].shape.dims[0].size < 8),
+        lambda pt: (("data", "model"),)
+        + tuple(() for _ in pt.shape.dims[1:]))
+    assert "overshard" in [f.code for f in res.errors()]
+
+
+def test_fuzzer_bad_permutation(ring_model, monkeypatch):
+    """Corrupting the ONE shared ring-schedule builder is caught for a
+    plan that actually runs a ring — and the clean plan passes."""
+    from flexflow_tpu.parallel import ops as par_ops
+
+    clean = _analyze(ring_model)
+    assert clean.ok, [str(f) for f in clean.errors()]
+    assert any("ring attention" in f.message or "ring schedule"
+               in f.message for f in clean.findings)
+
+    good = par_ops.ring_permutation
+    monkeypatch.setattr(par_ops, "ring_permutation",
+                        lambda n: good(n)[:-1])
+    res = _analyze(ring_model)
+    assert [f.code for f in res.errors()] == ["bad_permutation"]
+
+
+def test_fuzzer_donated_reuse():
+    from flexflow_tpu.analysis.lint import lint_source
+
+    src = (
+        "def loop(self, rng, batch):\n"
+        "    out = step_fn(self._params, self._state, self._slots,\n"
+        "                  self._step, self._counters, rng, batch)\n"
+        "    stale = self._params['head']\n"
+        "    return out, stale\n")
+    codes = [f.code for f in lint_source(src, select=("donated_reuse",))]
+    assert codes == ["donated_reuse"]
+
+    # the carry pattern — donated args rebound by the call's own
+    # assignment — is clean
+    ok = (
+        "def loop(self, rng, batch):\n"
+        "    (self._params, self._state, self._slots, self._step,\n"
+        "     self._counters, loss) = step_fn(\n"
+        "        self._params, self._state, self._slots, self._step,\n"
+        "        self._counters, rng, batch)\n"
+        "    return self._params, loss\n")
+    assert lint_source(ok, select=("donated_reuse",)) == []
+
+
+def test_fuzzer_coordinator_collective():
+    from flexflow_tpu.analysis.lint import lint_source
+
+    src = (
+        "def commit(payload):\n"
+        "    if is_coordinator():\n"
+        "        write(payload)\n"
+        "        barrier('commit')\n")
+    codes = [f.code for f in
+             lint_source(src, select=("coordinator_collective",))]
+    assert codes == ["coordinator_collective"]
+
+    # the sanctioned idiom: gate the payload, not the collective
+    ok = (
+        "def commit(payload):\n"
+        "    data = broadcast_json(payload if is_coordinator()\n"
+        "                          else None)\n"
+        "    return data\n")
+    assert lint_source(ok, select=("coordinator_collective",)) == []
+
+    # negated guard: the ELSE branch is coordinator-only
+    neg = (
+        "def commit(payload):\n"
+        "    if not is_coordinator():\n"
+        "        pass\n"
+        "    else:\n"
+        "        barrier('commit')\n")
+    assert [f.code for f in
+            lint_source(neg, select=("coordinator_collective",))] \
+        == ["coordinator_collective"]
+
+
+# ============================================== clean plans, six sources
+
+
+def test_clean_plan_all_six_sources(tmp_path):
+    """Every plan-adoption path funnels through the compile gate and
+    verifies with zero errors: search, cache, checkpoint, import,
+    manual, default."""
+    from flexflow_tpu.parallel.strategies import (
+        Strategy,
+        megatron_transformer,
+    )
+
+    seen = {}
+
+    def record(ff, expect):
+        assert ff._plan_source == expect
+        res = ff._analysis
+        assert res is not None, f"{expect}: gate did not run"
+        assert res.ok, (expect, [str(f) for f in res.errors()])
+        seen[expect] = res.summary()
+
+    search_argv = ["--mesh", "2,4,1,1", "--budget", "6",
+                   "--enable-parameter-parallel"]
+    ff = _compile(_lm(_config(search_argv))[0])
+    record(ff, "search")
+    plan_path = str(tmp_path / "plan.json")
+    Strategy(ff._strategy or {}).save(plan_path)
+
+    ws = str(tmp_path / "warmstart")
+    _compile(_lm(_config(search_argv + ["--warmstart-dir", ws]))[0])
+    record(_compile(_lm(_config(
+        search_argv + ["--warmstart-dir", ws]))[0]), "cache")
+
+    ck = str(tmp_path / "ckpt")
+    ck_argv = search_argv + ["--checkpoint-dir", ck,
+                             "--checkpoint-every", "1", "--auto-resume"]
+    ff, cfg = _lm(_config(ck_argv))
+    _compile(ff)
+    rs = np.random.RandomState(0)
+    X = {"tokens": rs.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32),
+         "positions": np.tile(np.arange(16, dtype=np.int32), (8, 1))}
+    Y = rs.randint(0, cfg.vocab_size, (8, 16, 1)).astype(np.int32)
+    ff.fit(X, Y, epochs=1, batch_size=4, shuffle=False, verbose=False)
+    record(_compile(_lm(_config(ck_argv))[0]), "checkpoint")
+
+    record(_compile(_lm(_config(
+        ["--mesh", "2,4,1,1", "--import-strategy", plan_path]))[0]),
+        "import")
+
+    ff, _ = _lm(_config(["--mesh", "2,4,1,1"]))
+    ff.set_strategy(megatron_transformer(ff))
+    record(_compile(ff), "manual")
+
+    record(_compile(_lm(_config(["--mesh", "2,4,1,1"]))[0]), "default")
+    assert sorted(seen) == sorted(
+        ["search", "cache", "checkpoint", "import", "manual", "default"])
+
+
+def test_poisoned_cache_entry_reads_as_miss(tmp_path):
+    """A plan-cache entry with an invalid sharding must read as a miss
+    (re-search), never crash the compile."""
+    from flexflow_tpu.warmstart.plan_cache import PlanCache
+
+    cache = PlanCache(str(tmp_path))
+    poisoned = {"version": 1, "nodes": {
+        "l": {"outputs": {"0": [["data"], ["data"]]}, "weights": {}}}}
+    path = cache.store("f" * 64, poisoned, {"data": 2})
+    assert path is not None
+    assert cache.lookup("f" * 64) is None  # verifier precheck → miss
+
+
+# ======================================================= memory liveness
+
+
+def test_memory_oom_gate_and_escape_hatch():
+    from flexflow_tpu.analysis import PlanVerificationError
+
+    argv = ["--mesh", "4,1,1,1", "-ll:fsize", "0.001"]  # ~1 KiB cap
+    with pytest.raises(PlanVerificationError) as e:
+        _compile(_lm(_config(argv))[0])
+    assert "oom_predicted" in [f.code for f in e.value.result.errors()]
+
+    ff = _compile(_lm(_config(argv + ["--no-verify-plan"]))[0])
+    assert ff._compiled
+    assert "oom_predicted" in [f.code for f in ff._analysis.errors()]
+
+
+def test_memory_crosscheck_against_cost_model(searched):
+    """The liveness estimate and the pricer's Σ agree within the
+    transient slack on a real plan (no divergence finding), and the
+    timeline attributes the peak to an op."""
+    res = _analyze(searched)
+    assert not res.by_code("memory_model_divergence"), \
+        [str(f) for f in res.findings]
+    tl = res.by_code("memory_timeline")
+    assert tl and tl[0].details["peak_bytes"] > 0
+    assert tl[0].details["peak_at"] != "(weights)"
+    assert tl[0].details["cost_model_bytes"] > 0
+
+
+def test_memory_counts_update_sharding():
+    """Under the ZeRO-sharded update the persistent (masters + slots)
+    term shrinks: the analysis must price the 1/dp layout, not the
+    replicated one — same accounting as the cost model."""
+    from flexflow_tpu.analysis.memory import analyze
+
+    argv = ["--mesh", "4,1,1,1"]
+    rep = _compile(_lm(_config(argv + ["--no-weight-update-sharding"]),
+                       layers=2)[0], momentum=0.9)
+    sh = _compile(_lm(_config(argv + ["--weight-update-sharding"]),
+                      layers=2)[0], momentum=0.9)
+    assert sh.executor.update_specs  # really sharded
+    m_rep = analyze(rep.graph, rep.mesh, opt_slots=2,
+                    update_specs=rep.executor.update_specs)
+    m_sh = analyze(sh.graph, sh.mesh, opt_slots=2,
+                   update_specs=sh.executor.update_specs)
+    assert m_sh["persistent_bytes"] < m_rep["persistent_bytes"]
+
+
+def test_memory_inference_accounting(searched):
+    """An inference compile (serving decode graphs) carries no grads,
+    optimizer slots, or retained activations — the liveness model must
+    charge trainable weights 1x and free activations after their last
+    consumer, or a trained-then-served model would trip the OOM gate on
+    a serving launch that fits."""
+    from flexflow_tpu.analysis.memory import analyze
+
+    train = analyze(searched.graph, searched.mesh, opt_slots=2,
+                    training=True)
+    infer = analyze(searched.graph, searched.mesh, opt_slots=2,
+                    training=False)
+    assert infer["persistent_bytes"] < train["persistent_bytes"]
+    assert infer["peak_bytes"] < train["peak_bytes"]
+    assert all(t["phase"] == "fwd" for t in infer["timeline"])
+
+
+# ========================================================== collectives
+
+
+def test_bucket_order_determinism(searched):
+    """Out-of-order update buckets are a multihost hazard; the pass
+    recomputes topological order and flags a mismatch."""
+    from flexflow_tpu.analysis import collectives
+    from jax.sharding import PartitionSpec as P
+
+    order = [n.name for n in searched.graph.topo_order()
+             if n.weight_specs]
+
+    class Ctx:
+        update_specs = {
+            (order[-1], "kernel"): (P("data"), (32, 32)),
+            (order[0], "kernel"): (P("data"), (32, 32)),
+        }
+
+    codes = [f.code for f in
+             collectives.run(searched.graph, searched.mesh, Ctx())]
+    assert "nondeterministic_bucket_order" in codes
+
+    class Ok:
+        update_specs = {
+            (order[0], "kernel"): (P("data"), (32, 32)),
+            (order[-1], "kernel"): (P("data"), (32, 32)),
+        }
+
+    codes = [f.code for f in
+             collectives.run(searched.graph, searched.mesh, Ok())]
+    assert "nondeterministic_bucket_order" not in codes
+
+
+# ================================================================ lint
+
+
+def test_lint_host_sync_in_loop():
+    from flexflow_tpu.analysis.lint import lint_source
+
+    hot = (
+        "def fit(self):\n"
+        "    for b in batches:\n"
+        "        out = step(b)\n"
+        "        loss = float(np.asarray(jax.device_get(out)))\n")
+    assert [f.code for f in
+            lint_source(hot, select=("host_sync_in_loop",))] \
+        == ["host_sync_in_loop"]
+
+    gated = (
+        "def fit(self):\n"
+        "    for b in batches:\n"
+        "        out = step(b)\n"
+        "        if tel is not None:\n"
+        "            loss = float(jax.device_get(out))\n")
+    assert lint_source(gated, select=("host_sync_in_loop",)) == []
+
+    derived_gate = (
+        "def fit(self, tel):\n"
+        "    need_losses = tel is not None\n"
+        "    for b in batches:\n"
+        "        out = step(b)\n"
+        "        loss = (jax.device_get(out) if need_losses else None)\n")
+    assert lint_source(derived_gate, select=("host_sync_in_loop",)) == []
+
+    pragma = (
+        "def calibrate(self):\n"
+        "    for _ in range(3):\n"
+        "        t = float(jax.device_get(run()))  "
+        "# fflint: ok host_sync_in_loop\n")
+    assert lint_source(pragma, select=("host_sync_in_loop",)) == []
+
+
+def test_lint_unsorted_dict_hash():
+    from flexflow_tpu.analysis.lint import lint_source
+
+    bad = (
+        "def calibration_fingerprint(db):\n"
+        "    entries = []\n"
+        "    for k, v in db.items():\n"
+        "        entries.append([k, v])\n"
+        "    return _sha(entries)\n")
+    assert [f.code for f in
+            lint_source(bad, select=("unsorted_dict_hash",))] \
+        == ["unsorted_dict_hash"]
+
+    ok = bad.replace("db.items()", "sorted(db.items())")
+    assert lint_source(ok, select=("unsorted_dict_hash",)) == []
+
+    # dict iteration outside hash context is not the lint's business
+    other = (
+        "def render(d):\n"
+        "    for k, v in d.items():\n"
+        "        print(k, v)\n")
+    assert lint_source(other, select=("unsorted_dict_hash",)) == []
+
+
+def test_lint_global_rng():
+    from flexflow_tpu.analysis.lint import lint_source
+
+    assert [f.code for f in lint_source(
+        "def f():\n    np.random.seed(0)\n",
+        select=("global_rng",))] == ["global_rng"]
+    assert lint_source(
+        "def f():\n    rs = np.random.RandomState(0)\n    rs.shuffle(x)\n",
+        select=("global_rng",)) == []
+
+
+def test_lint_time_in_trace():
+    from flexflow_tpu.analysis.lint import lint_source
+
+    jitted = (
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    t = time.time()\n"
+        "    return x + t\n")
+    assert [f.code for f in
+            lint_source(jitted, select=("time_in_trace",))] \
+        == ["time_in_trace"]
+
+    scanned = (
+        "def chunk(xs):\n"
+        "    def body(carry, x):\n"
+        "        return carry + time.perf_counter(), x\n"
+        "    return jax.lax.scan(body, 0.0, xs)\n")
+    assert [f.code for f in
+            lint_source(scanned, select=("time_in_trace",))] \
+        == ["time_in_trace"]
+
+    host = (
+        "def fit(xs):\n"
+        "    t0 = time.perf_counter()\n"
+        "    out = step(xs)\n"
+        "    return out, time.perf_counter() - t0\n")
+    assert lint_source(host, select=("time_in_trace",)) == []
+
+
+def test_fflint_repo_clean():
+    """The CI invariant, enforced in tier-1 too: the repo's own runtime
+    + scripts code carries zero fflint findings (hazards are either
+    fixed or carry an explicit justified pragma)."""
+    from flexflow_tpu.analysis.lint import lint_paths
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(root, p)
+             for p in ("flexflow_tpu", "scripts", "bench.py")]
+    findings = lint_paths([p for p in paths if os.path.exists(p)])
+    assert findings == [], [str(f) for f in findings]
+
+
+# ============================================================ donation
+
+
+def test_donation_registry_matches_executor():
+    from flexflow_tpu.analysis.donation import registry_problems
+
+    assert registry_problems() == []
+
+
+def test_donation_registry_detects_drift(tmp_path):
+    """If the executor's donate_argnums change and the registry lags,
+    the pass fails loudly instead of scanning with stale argnums."""
+    from flexflow_tpu.analysis.donation import registry_problems
+
+    fake = tmp_path / "executor.py"
+    fake.write_text(
+        "class Executor:\n"
+        "    def build_train_step(self):\n"
+        "        self._train_step = jax.jit(\n"
+        "            self._train_step_body,\n"
+        "            donate_argnums=_donate_argnums((0, 1)))\n"
+        "        return self._train_step\n")
+    codes = [f.code for f in registry_problems(str(fake))]
+    assert "donation_registry_mismatch" in codes
+
+
+# ========================================================= integration
+
+
+def test_report_carries_analysis_section(tmp_path):
+    """strategy_report.json surfaces the compile gate's findings in an
+    `analysis` section (summary + per-finding entries)."""
+    tdir = str(tmp_path / "tel")
+    ff, _ = _lm(_config(["--mesh", "2,4,1,1", "--budget", "6",
+                         "--enable-parameter-parallel",
+                         "--telemetry-dir", tdir, "--diagnostics"]))
+    _compile(ff)
+    with open(os.path.join(tdir, "strategy_report.json")) as f:
+        report = json.load(f)
+    a = report.get("analysis")
+    assert a is not None
+    assert a["errors"] == 0
+    assert a["passes_run"] == ["sharding_dataflow", "memory_liveness",
+                               "collective_uniformity",
+                               "donation_aliasing"]
+    assert any(f["code"] == "memory_timeline" for f in a["findings"])
+
+
+def test_verify_telemetry_event(tmp_path):
+    """The compile gate emits a plan_verify metrics record with the
+    summary counts and its elapsed time."""
+    from flexflow_tpu.telemetry import read_jsonl
+
+    tdir = str(tmp_path / "tel")
+    ff = _compile(_lm(_config(["--mesh", "2,4,1,1",
+                               "--telemetry-dir", tdir]))[0])
+    assert ff._analysis is not None
+    recs = [r for r in read_jsonl(os.path.join(tdir, "metrics.jsonl"))
+            if r.get("kind") == "plan_verify"]
+    assert recs and recs[0]["errors"] == 0
+    assert recs[0]["plan_source"] == "default"
+    assert recs[0]["elapsed_s"] >= 0
