@@ -24,11 +24,21 @@ Passes (docs/analysis.md has the full catalog):
 4. `donation_aliasing`  — donated step buffers are never read host-side
    after the call; the donation registry is re-derived from executor.py
    and cross-checked.
+5. `dtype_flow`         — ffsan's precision lattice over the PCG under
+   the mixed-precision policy: low-precision accumulation over large
+   reductions, fp32-master bypass, downcast→upcast round trips, dtype
+   mismatches across parallel-op edges (numerics.py).
+6. `spmd_uniformity`    — host-divergent branches feeding collectives or
+   traced code (the r13 divergence class, generalized); the module also
+   hosts the opt-in runtime fingerprint barrier (spmd.py,
+   `--spmd-barrier`).
 
 Findings land in the `analysis` section of strategy_report.json
 (severity error/warning/info); errors abort compile unless
 `--no-verify-plan`. `scripts/fflint.py` runs the source-level hazard
-rules (analysis/lint.py) repo-wide as the sibling CI gate.
+rules (analysis/lint.py) repo-wide as the sibling CI gate; the runtime
+NaN-provenance sanitizer (`--sanitize-numerics`, flexflow_tpu/
+sanitize.py) is ffsan's dynamic half.
 """
 
 from __future__ import annotations
@@ -36,7 +46,16 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from . import collectives, donation, lint, memory, sharding, sources
+from . import (
+    collectives,
+    donation,
+    lint,
+    memory,
+    numerics,
+    sharding,
+    sources,
+    spmd,
+)
 from .findings import (
     AnalysisResult,
     Finding,
@@ -50,16 +69,20 @@ __all__ = [
     "AnalysisContext", "AnalysisResult", "Finding",
     "PlanVerificationError", "run_analysis", "verify_plan",
     "verify_strategy", "PASSES", "SEV_ERROR", "SEV_WARNING", "SEV_INFO",
-    "collectives", "donation", "lint", "memory", "sharding", "sources",
+    "collectives", "donation", "lint", "memory", "numerics", "sharding",
+    "sources", "spmd",
 ]
 
 # (name, runner) in execution order; each runner is
-# fn(graph, mesh, ctx) -> list[Finding]
+# fn(graph, mesh, ctx) -> list[Finding]. Passes 5 and 6 are the ffsan
+# layer (dtype-flow numerics + SPMD uniformity, ISSUE 10).
 PASSES = (
     ("sharding_dataflow", sharding.run),
     ("memory_liveness", memory.run),
     ("collective_uniformity", collectives.run),
     ("donation_aliasing", donation.run),
+    ("dtype_flow", numerics.run),
+    ("spmd_uniformity", spmd.run),
 )
 
 
@@ -69,13 +92,17 @@ class AnalysisContext:
 
     def __init__(self, machine=None, cost_model=None, opt_slots: int = 1,
                  update_specs=None, training: bool = True,
-                 hbm_cap_bytes: float = 0.0):
+                 hbm_cap_bytes: float = 0.0, config=None):
         self.machine = machine
         self.cost_model = cost_model
         self.opt_slots = opt_slots
         self.update_specs = update_specs or {}
         self.training = training
         self.hbm_cap_bytes = hbm_cap_bytes
+        # FFConfig (or None): the dtype-flow pass reads the
+        # mixed-precision policy (computation_dtype / tensor-op math)
+        # from the same source the executor lowers
+        self.config = config
 
 
 def run_analysis(graph, mesh, ctx: Optional[AnalysisContext] = None,
@@ -139,6 +166,7 @@ def context_for_model(model, cost_model=None) -> AnalysisContext:
         training=(model.config.computation_mode
                   == CompMode.COMP_MODE_TRAINING),
         hbm_cap_bytes=cap,
+        config=model.config,
     )
 
 

@@ -36,6 +36,10 @@ _SEVERITIES = (SEV_ERROR, SEV_WARNING, SEV_INFO)
 #   collective checks:   bad_permutation, nondeterministic_bucket_order,
 #                        coordinator_collective
 #   donation/aliasing:   donated_reuse, donation_registry_mismatch
+#   dtype flow (ffsan):  low_precision_accum, master_bypass,
+#                        downcast_roundtrip, parallel_dtype_mismatch,
+#                        numerics_clean
+#   spmd uniformity:     host_divergent_branch, spmd_clean
 #   lint (fflint rules): host_sync_in_loop, unsorted_dict_hash,
 #                        global_rng, time_in_trace
 

@@ -30,6 +30,19 @@ Every rule encodes a bug class a past PR fixed by hand:
   executable (train step / chunked scan / decode step) and then read
   host-side without being rebound by the call's own assignment: the
   donated buffer is dead after the call on backends that honor donation.
+- `low_precision_accum` — a summing reduction (`jnp.sum`/`mean`/
+  `prod`/`cumsum`/`logsumexp`/`einsum`) whose argument is explicitly
+  cast to bf16/fp16 (or whose `dtype=` pins a low-precision
+  accumulator). Long low-precision sums drift (Micikevicius et al.,
+  PAPERS.md "Numerics"); the codebase's convention is f32 accumulation
+  with one final downcast (loss.py, ops/core.py) — the ffsan dtype-flow
+  pass checks the same invariant at the graph level.
+- `host_divergent_branch` — an `if` whose test calls a per-host-
+  nondeterministic source (time.*, RNG, os.environ/getenv,
+  socket.gethostname) guarding a collective (deadlock: some hosts never
+  arrive — error) or a trace-entry call (hosts compile divergent
+  executables — warning). The r13 multihost pricing divergence
+  generalized: gate on a BROADCAST value, never a locally measured one.
 
 Suppression: a trailing `# fflint: ok` (optionally naming codes,
 `# fflint: ok host_sync_in_loop`) on the flagged line or its enclosing
@@ -50,7 +63,8 @@ from .findings import Finding, SEV_ERROR, SEV_WARNING
 PASS_NAME = "fflint"
 
 ALL_RULES = ("host_sync_in_loop", "unsorted_dict_hash", "global_rng",
-             "time_in_trace", "coordinator_collective", "donated_reuse")
+             "time_in_trace", "coordinator_collective", "donated_reuse",
+             "low_precision_accum", "host_divergent_branch")
 
 # identifiers whose presence in an `if` test marks the branch as a
 # telemetry/diagnostics gate (a gated fetch is the sanctioned pattern)
@@ -88,6 +102,10 @@ DONATED_CALLEES = {
 }
 
 _HASH_FN_HINTS = ("fingerprint", "signature", "digest", "_sha", "hash")
+
+# summing reductions the low-precision-accumulation rule watches
+# (order statistics — max/min/argmax — carry no accumulation error)
+_SUM_FUNCS = {"sum", "mean", "prod", "cumsum", "logsumexp", "einsum"}
 
 
 def _dotted(node) -> str:
@@ -519,6 +537,102 @@ class _FileLint:
         while cur is not None and not isinstance(cur, ast.stmt):
             cur = self._parents.get(id(cur))
         return cur
+
+    # --------------------------------------- rule: low-precision accum
+
+    def _low_precision_expr(self, node) -> str:
+        """Name of the low-precision dtype an expression subtree pins
+        ('' when none): an astype()/dtype= targeting bfloat16/float16."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and \
+                    _last_ident(sub.func) == "astype":
+                for a in sub.args:
+                    d = _dotted(a) or (a.value if isinstance(
+                        a, ast.Constant) and isinstance(a.value, str)
+                        else "")
+                    if isinstance(d, str) and d.split(".")[-1] in (
+                            "bfloat16", "float16"):
+                        return d
+        return ""
+
+    def rule_low_precision_accum(self):
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if _last_ident(call.func) not in _SUM_FUNCS:
+                continue
+            lp = ""
+            for kw in call.keywords:
+                if kw.arg in ("dtype", "preferred_element_type"):
+                    d = _dotted(kw.value)
+                    if d.split(".")[-1] in ("bfloat16", "float16"):
+                        lp = d
+            if not lp:
+                for a in call.args:
+                    lp = self._low_precision_expr(a)
+                    if lp:
+                        break
+            if lp:
+                self._emit(
+                    call, SEV_WARNING, "low_precision_accum",
+                    f"{_last_ident(call.func)}() accumulates in "
+                    f"{lp.split('.')[-1]} — long low-precision sums "
+                    f"drift; reduce in f32 and downcast the result "
+                    f"(loss.py / ops/core.py convention)")
+
+    # ------------------------------------ rule: host-divergent branch
+
+    def _divergent_source(self, test) -> str:
+        """Dotted name of a per-host-nondeterministic call in an `if`
+        test ('' when none)."""
+        for n in ast.walk(test):
+            if not isinstance(n, ast.Call):
+                continue
+            d = _dotted(n.func)
+            parts = d.split(".")
+            if len(parts) == 2 and parts[0] == "time" \
+                    and parts[1] in _TIME_FUNCS:
+                return d
+            if self._rng_call(n):
+                return d
+            if d in ("os.getenv", "os.environ.get",
+                     "socket.gethostname", "platform.node"):
+                return d
+        return ""
+
+    def rule_host_divergent_branch(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.If):
+                continue
+            src = self._divergent_source(node.test)
+            if not src:
+                continue
+            for stmts in (node.body, node.orelse):
+                for sub in stmts:
+                    for call in ast.walk(sub):
+                        if not isinstance(call, ast.Call):
+                            continue
+                        callee = _last_ident(call.func)
+                        if callee in _COLLECTIVES:
+                            self._emit(
+                                call, SEV_ERROR,
+                                "host_divergent_branch",
+                                f"collective {callee}() behind a branch "
+                                f"on {src}() — hosts evaluate the test "
+                                f"differently and some never reach the "
+                                f"collective: fleet deadlock. Decide on "
+                                f"the coordinator and broadcast_json "
+                                f"the verdict", source=src)
+                        elif callee in _TRACE_ENTRY:
+                            self._emit(
+                                call, SEV_WARNING,
+                                "host_divergent_branch",
+                                f"trace entry {callee}() behind a "
+                                f"branch on {src}() — hosts may compile "
+                                f"divergent executables (the r13 "
+                                f"pricing-divergence class); key the "
+                                f"decision on broadcast state",
+                                source=src)
 
     # ---------------------------------------------------------------- run
 

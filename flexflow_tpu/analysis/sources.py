@@ -32,7 +32,10 @@ RUNTIME_MODULES = (
 
 # the source-level rules the pass pipeline consumes; scanned together in
 # ONE pass over the module set so the files are parsed once per process
-_SOURCE_RULES = ("coordinator_collective", "donated_reuse")
+# (pass 3 takes coordinator_collective, pass 4 donated_reuse, pass 6 —
+# spmd_uniformity — host_divergent_branch)
+_SOURCE_RULES = ("coordinator_collective", "donated_reuse",
+                 "host_divergent_branch")
 
 _cache: list | None = None
 

@@ -180,6 +180,22 @@ class FFConfig:
     # section. --no-verify-plan is the escape hatch (findings downgrade
     # to logged warnings).
     verify_plan: bool = True
+    # ffsan runtime half (flexflow_tpu/sanitize.py): instrument the
+    # train/eval/decode step with per-op finiteness probes (forward
+    # values AND backward cotangents) so a NaN/inf is attributed to the
+    # exact (op, fwd|bwd, step) that produced it — the nan_loss health
+    # alert then names the culprit instead of just declaring the run
+    # dead. Zero-cost when off (no probes are traced); value-identical
+    # when on (probes are effectful identities).
+    sanitize_numerics: bool = False
+    # SPMD fingerprint barrier (analysis/spmd.py): before the first
+    # step, every process cross-checks a digest of its step-executable
+    # ingredients (plan fingerprint, strategy, donation registry +
+    # realized probe verdict, update-spec layout, numerics policy)
+    # against the coordinator's over broadcast_json; a mismatch raises
+    # SPMDDivergenceError on every process in lockstep. One small
+    # broadcast when on; nothing when off.
+    spmd_barrier: bool = False
     # eager-loop diagnostics loss fetch cadence: the per-step device_get
     # is a full device drain; K>1 samples it every K-th step and the
     # health/drift rules then see one K-step-AVERAGED record per window
@@ -381,6 +397,10 @@ class FFConfig:
                 self.pipeline_steps = int(val())
             elif a == "--no-verify-plan":
                 self.verify_plan = False
+            elif a == "--sanitize-numerics":
+                self.sanitize_numerics = True
+            elif a == "--spmd-barrier":
+                self.spmd_barrier = True
             elif a == "--health-sample-every":
                 self.health_sample_every = int(val())
             elif a == "--serve-slots":

@@ -315,6 +315,13 @@ def build_strategy_report(model) -> dict:
         # the report so run_doctor / CI can audit the plan's static
         # verification next to the makespan identity
         report["analysis"] = analysis.to_json()
+    # ffsan state: whether the compiled step carries the numerics
+    # probes, and the SPMD fingerprint-barrier verdict — run_doctor
+    # --check gates on these next to the analysis section
+    report["sanitize_numerics"] = bool(
+        getattr(model.config, "sanitize_numerics", False))
+    report["spmd_barrier"] = (
+        getattr(model, "_spmd_barrier", None) or {}).get("status", "off")
     return report
 
 
@@ -338,6 +345,10 @@ def render_markdown(report: dict) -> str:
             f"- static verification (ffcheck): {a['errors']} error(s), "
             f"{a['warnings']} warning(s) across "
             f"{', '.join(a['passes_run'])}")
+    lines.append(
+        f"- ffsan: sanitizer "
+        f"{'ON' if report.get('sanitize_numerics') else 'off'}"
+        f"  ·  SPMD barrier: {report.get('spmd_barrier', 'off')}")
     if report.get("update_sharding"):
         lines.append(
             f"- weight-update sharding: ON — masters + optimizer slots "

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import math
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 
@@ -48,13 +48,19 @@ class Alert:
     value: float = 0.0
     threshold: float = 0.0
     action: str = "warn"  # "warn" | "abort"
+    # structured context (e.g. the sanitizer's nan_loss localization:
+    # op / phase / at_step) — serialized only when present
+    details: dict = field(default_factory=dict)
 
     def to_record(self) -> dict:
-        return {
+        rec = {
             "rule": self.rule, "level": self.level, "step": int(self.step),
             "message": self.message, "value": float(self.value),
             "threshold": float(self.threshold), "action": self.action,
         }
+        if self.details:
+            rec["details"] = dict(self.details)
+        return rec
 
 
 class Rule:
@@ -81,7 +87,11 @@ class Rule:
 
 
 class NaNLossRule(Rule):
-    """Loss is NaN or inf: the run is numerically dead."""
+    """Loss is NaN or inf: the run is numerically dead. With
+    --sanitize-numerics the step record additionally carries the
+    sanitizer's localization (nonfinite_op/phase/step, sanitize.py) and
+    the one alert — fire-once semantics unchanged — names the exact op
+    and pass that produced the first non-finite tensor."""
 
     name = "nan_loss"
     fire_once = True
@@ -93,11 +103,25 @@ class NaNLossRule(Rule):
         loss = float(loss)
         if math.isfinite(loss):
             return None
+        details = {}
+        origin = ""
+        op = rec.get("nonfinite_op")
+        if op:
+            phase = ("backward" if rec.get("nonfinite_phase") == "bwd"
+                     else "forward")
+            at = rec.get("nonfinite_step")
+            origin = (f" — first non-finite tensor: {op} ({phase}) "
+                      f"at step {at}")
+            details = {"op": op,
+                       "phase": rec.get("nonfinite_phase"),
+                       "at_step": at}
         return Alert(
             rule=self.name, level="error", step=int(rec.get("step", 0)),
             message=(f"non-finite loss ({loss}) at step "
-                     f"{rec.get('step', '?')} — the model diverged"),
-            value=loss if math.isnan(loss) else math.inf)
+                     f"{rec.get('step', '?')} — the model diverged"
+                     f"{origin}"),
+            value=loss if math.isnan(loss) else math.inf,
+            details=details)
 
 
 class StepSpikeRule(Rule):

@@ -242,13 +242,20 @@ class PipelinedEngine:
                 # spike/drift baselines wrong (every timing rule skips
                 # None fields; the telemetry records above stay honest
                 # wall time, like the eager loop's step-1 record).
-                diag.on_step({
+                loss_i = (float(loss_host[i])
+                          if loss_host is not None else None)
+                rec = {
                     "step": step, "epoch": abs_e, "t": time.time(),
                     "step_time_s": None if compiled_now else step_time,
                     "data_wait_s": None if compiled_now else data_wait,
                     "save_latency_s": None if compiled_now else save_lat,
                     "device_time_s": None if compiled_now else max(
                         0.0, step_time - data_wait - save_lat),
-                    "loss": (float(loss_host[i])
-                             if loss_host is not None else None),
-                })
+                    "loss": loss_i,
+                }
+                # sanitizer attribution rides the same record shape as
+                # the eager loop's (the scan's probes carried the real
+                # per-iteration step value, so localization still names
+                # the exact step inside the chunk)
+                rec.update(self.model._nonfinite_localization(loss_i))
+                diag.on_step(rec)

@@ -121,6 +121,16 @@ class Executor:
         # chunked (lax.scan) train steps keyed by chunk length — the
         # pipelined engine's fused multi-step dispatch (engine/)
         self._chunk_steps: dict[int, Any] = {}
+        # ffsan runtime sanitizer (--sanitize-numerics, sanitize.py):
+        # when on, _apply wraps every op output in finiteness probes
+        # (fwd value + bwd cotangent) that localize the first non-finite
+        # tensor to (op, phase, step). Off → no probes traced, the step
+        # is byte-identical to the uninstrumented one.
+        self.sanitize_numerics = bool(
+            getattr(config, "sanitize_numerics", False))
+        # test/debug fault injection: (op_name | "loss", "fwd"|"bwd",
+        # step) — poisons exactly that tensor from that step on
+        self._numeric_fault: Optional[tuple] = None
 
     def _build_update_specs(self):
         """Resolve the per-weight update shardings through the SAME
@@ -256,7 +266,46 @@ class Executor:
             tree,
         )
 
-    def make_loss_fn(self, state, x_inputs, labels, rng):
+    def set_numeric_fault(self, op: Optional[str], phase: str = "fwd",
+                          step: int = 0):
+        """Install (or clear, op=None) a numeric fault: the named op's
+        output (or its cotangent, phase="bwd"; op "loss" targets the
+        scalar loss) goes NaN from global step `step` on. Test/debug
+        hook for the sanitizer's localization matrix — the cached step
+        executables are dropped so the next dispatch retraces with the
+        fault baked in."""
+        if op is not None:
+            if phase not in ("fwd", "bwd"):
+                raise ValueError(f"phase must be fwd|bwd, got {phase!r}")
+            if op != "loss" and all(n.name != op for n in self.order):
+                raise ValueError(f"no op named {op!r} in the graph")
+        self._numeric_fault = (
+            None if op is None else (op, phase, int(step)))
+        self._train_step = None
+        self._eval_step = None
+        self._forward_fn = None
+        self._decode_step = None
+        self._chunk_steps.clear()
+
+    def _maybe_poison(self, x, name: str, step, phase: str):
+        """Apply the installed numeric fault to tensor `name`, for the
+        given phase only. Wrap order vs the sanitizer probe matters: a
+        fwd fault is applied BEFORE the probe (so the probe sees the
+        poisoned value), a bwd fault AFTER it (so the probe's backward
+        sees the poisoned cotangent — bwd composition reverses the
+        forward wrap order)."""
+        fault = self._numeric_fault
+        if fault is None or fault[0] != name or fault[1] != phase:
+            return x
+        from . import sanitize
+
+        _op, _phase, at = fault
+        if phase == "fwd":
+            return sanitize.inject_nonfinite(x, step, at)
+        return sanitize.inject_grad_nonfinite(
+            x, step if step is not None else jnp.int32(-1), at)
+
+    def make_loss_fn(self, state, x_inputs, labels, rng, step=None):
         """Shared mixed-precision loss closure for the fused train step and
         the granular FFModel.backward: bf16 compute casts on params/inputs
         (state is passed uncast — ops own their fp32-statistics handling).
@@ -276,12 +325,21 @@ class Executor:
 
         def loss_fn(p):
             logits, new_state, aux = self._apply(
-                p, state, xc, training=True, rng=rng
+                p, state, xc, training=True, rng=rng, step=step
             )
             l, ce_sum = loss_terms(
                 self.loss_type, logits, labels, self.last_op_is_softmax
             )
-            return l + aux, (logits, new_state, ce_sum)
+            total = l + aux
+            total = self._maybe_poison(total, "loss", step, "fwd")
+            if self.sanitize_numerics:
+                from . import sanitize
+
+                # the loss sits one past the last graph op in topo space
+                total = sanitize.probe(total, step, "loss",
+                                       len(self.order))
+            total = self._maybe_poison(total, "loss", step, "bwd")
+            return total, (logits, new_state, ce_sum)
 
         return loss_fn
 
@@ -340,12 +398,18 @@ class Executor:
 
     # ------------------------------------------------------------ apply
 
-    def _apply(self, params, state, inputs, *, training, rng, seq_length=-1):
-        """Run the PCG forward. Returns (logits, new_state, aux_loss)."""
+    def _apply(self, params, state, inputs, *, training, rng,
+               seq_length=-1, step=None):
+        """Run the PCG forward. Returns (logits, new_state, aux_loss).
+        `step` (traced int or None) feeds the sanitizer probes and the
+        fault injector so localization carries the exact step inside
+        chunked lax.scan dispatches too."""
+        if self.sanitize_numerics:
+            from . import sanitize
         vals: dict[tuple[int, int], Any] = {}
         new_state = {k: dict(v) for k, v in state.items()}
         aux_loss = 0.0
-        for node in self.order:
+        for topo_idx, node in enumerate(self.order):
             if node.op_type in (OT.OP_INPUT, OT.OP_WEIGHT, OT.OP_NOOP):
                 if node.op_type == OT.OP_INPUT:
                     x = inputs[node.name]
@@ -408,6 +472,14 @@ class Executor:
                         out = jax.lax.with_sharding_constraint(
                             out, NamedSharding(self.mesh, spec)
                         )
+                if i == 0 and self._numeric_fault is not None:
+                    out = self._maybe_poison(out, node.name, step, "fwd")
+                if self.sanitize_numerics:
+                    label = (node.name if i == 0
+                             else f"{node.name}#out{i}")
+                    out = sanitize.probe(out, step, label, topo_idx)
+                if i == 0 and self._numeric_fault is not None:
+                    out = self._maybe_poison(out, node.name, step, "bwd")
                 vals[(node.guid, i)] = out
 
         logits = vals[(self.logits_node.guid, 0)]
@@ -422,7 +494,8 @@ class Executor:
         lax.scan body, so the pipelined engine is bit-identical to the
         eager loop by construction."""
         x_inputs, labels = batch
-        loss_fn = self.make_loss_fn(state, x_inputs, labels, rng)
+        loss_fn = self.make_loss_fn(state, x_inputs, labels, rng,
+                                    step=step)
         (lval, (logits, new_state, ce_sum)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params)

@@ -138,6 +138,10 @@ class FFModel:
         # ffcheck result (analysis.AnalysisResult) of the compile gate —
         # strategy_report.json surfaces it as its `analysis` section
         self._analysis = None
+        # SPMD fingerprint-barrier verdict ({status, fingerprint} or
+        # None when --spmd-barrier is off) — recorded at compile,
+        # surfaced in the compile metrics record + strategy_report.json
+        self._spmd_barrier = None
 
     # ================================================== tensor creation
 
@@ -697,6 +701,13 @@ class FFModel:
                     if self._strategy else [],
                     plan_source=self._plan_source,
                     plan_fingerprint=self._plan_fingerprint,
+                    # ffsan state: whether this compile's step carries
+                    # the numerics probes, and the fingerprint-barrier
+                    # verdict (run_doctor --check gates on both)
+                    sanitize_numerics=bool(
+                        self.config.sanitize_numerics),
+                    spmd_barrier=(self._spmd_barrier or {}).get(
+                        "status", "off"),
                 )
                 diag = self._maybe_enable_diagnostics()
                 if diag is not None:
@@ -1185,6 +1196,20 @@ class FFModel:
         from .analysis import verify_plan
 
         verify_plan(self, cost_model=search_cost_model)
+        # --- SPMD fingerprint barrier (analysis/spmd.py, --spmd-barrier):
+        # cross-host uniformity check of the step-executable ingredients
+        # BEFORE the first step — a diverged process raises a structured
+        # SPMDDivergenceError here instead of deadlocking a collective or
+        # silently training a different program. The verdict rides into
+        # the compile metrics record and strategy_report.json so
+        # run_doctor --check can gate on it.
+        self._spmd_barrier = None
+        if self.config.spmd_barrier:
+            from .analysis import spmd
+
+            with telemetry.span("compile.spmd_barrier"):
+                self._spmd_barrier = spmd.fingerprint_barrier(self)
+            telemetry.event("spmd_barrier", **self._spmd_barrier)
         self._rng = jax.random.key(self.config.seed)
         self._params, self._state = self.executor.init_variables(self._rng)
         # optimizer slots inherit the (possibly update-sharded) param
@@ -1440,6 +1465,28 @@ class FFModel:
         the keras ModelCheckpoint all go through here)."""
         return int(np.asarray(jax.device_get(self._step)))
 
+    def _nonfinite_localization(self, loss_val) -> dict:
+        """The sanitizer's (op, phase, step) attribution for a
+        non-finite loss, as extra keys for the health-rule step record
+        (NaNLossRule folds them into its alert). Empty when the loss is
+        finite, the sanitizer is off, or nothing was localized. The one
+        effects_barrier drains the probe callbacks of the step that
+        produced the NaN — paid only on the already-dead path."""
+        import math as _math
+
+        if (loss_val is None or _math.isfinite(loss_val)
+                or not self.config.sanitize_numerics):
+            return {}
+        from . import sanitize
+
+        jax.effects_barrier()
+        info = sanitize.get_monitor().first_nonfinite()
+        if info is None:
+            return {}
+        return {"nonfinite_op": info["op"],
+                "nonfinite_phase": info["phase"],
+                "nonfinite_step": info["step"]}
+
     def set_fault_hook(self, hook):
         """Install a per-step failure-injection hook (resilience/fault.py):
         called with the global step after each optimizer step + checkpoint
@@ -1499,6 +1546,15 @@ class FFModel:
             # idempotent: covers sessions attached after compile (keras
             # Telemetry callback, manual enable_telemetry)
             tel.write_manifest(self)
+        if self.config.sanitize_numerics:
+            # a fresh fit gets a fresh provenance window: stale
+            # non-finite reports from an earlier (diverged) fit in the
+            # same process must not win the min-step localization of
+            # THIS run's first NaN
+            from . import sanitize
+
+            jax.effects_barrier()
+            sanitize.get_monitor().reset()
         diag = self._maybe_enable_diagnostics()
         if diag is not None and diag.report is None:
             # diagnostics attached after compile (keras Diagnostics
@@ -1737,7 +1793,7 @@ class FFModel:
                                                        hw[1] / k,
                                                        hw[2] / k)
                                     health_win = [0.0, 0.0, 0.0, 0]
-                                    diag.on_step({
+                                    rec = {
                                         "step": py_step, "epoch": abs_e,
                                         "t": time.time(),
                                         "step_time_s": w_t,
@@ -1746,7 +1802,11 @@ class FFModel:
                                         "device_time_s": max(
                                             0.0, w_t - w_dw - w_sv),
                                         "loss": loss_val,
-                                    })
+                                    }
+                                    rec.update(
+                                        self._nonfinite_localization(
+                                            loss_val))
+                                    diag.on_step(rec)
                         if self._fault_hook is not None:
                             self._fault_hook(py_step)
                         if preempted:

@@ -1,0 +1,180 @@
+"""Runtime NaN-provenance sanitizer (`--sanitize-numerics`, ffsan's
+runtime half).
+
+The `nan_loss` health rule can only say "the run is dead"; this module
+says WHICH op killed it. With the flag on, the executor wraps every op
+output in a probe pair:
+
+  - a `jax.debug.callback` on the forward value's finiteness, and
+  - a `custom_vjp` identity whose backward runs the same callback on the
+    output's cotangent
+
+so the instrumented step reports, per step, every (op, fwd|bwd) whose
+tensor went non-finite — the callbacks carry the traced `step` value, so
+localization works inside the pipelined engine's `lax.scan` chunks
+exactly as in the eager loop. The host side keeps only NON-finite
+reports (the callback payload is two scalars; a healthy run crosses the
+host boundary with nothing).
+
+Localization semantics (`NumericsMonitor.first_nonfinite`):
+
+  fwd — the FIRST op in topo order whose output is non-finite at the
+        earliest affected step (NaN propagates downstream; the minimum
+        is the origin).
+  bwd — the op with the LARGEST topo index whose output cotangent is
+        non-finite (the backward pass runs in reverse topo order, so
+        cotangent NaN propagates toward smaller indices; the maximum is
+        where the gradient first went bad).
+
+Zero-cost when off: the executor inserts no probes, so the traced step
+is byte-identical to the uninstrumented one. With the flag on the probes
+are value-preserving identities — outputs stay bit-identical; only
+effects are added.
+
+`inject_nonfinite` / the grad twin are the matching fault injectors
+(tests and scripts/ffsan_smoke.py poison exactly one op at one step and
+assert the monitor names it).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class NumericsMonitor:
+    """Host-side collector of non-finite reports. One per process
+    (module singleton via get_monitor()); callbacks may fire from XLA's
+    callback threads, hence the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events: list[dict] = []
+
+    def reset(self):
+        with self._lock:
+            self.events = []
+
+    def report(self, op: str, phase: str, topo: int, step: int):
+        with self._lock:
+            self.events.append(
+                {"op": op, "phase": phase, "topo": int(topo),
+                 "step": int(step)})
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self.events)
+
+    def localize(self, step=None) -> dict | None:
+        """The origin (op, phase, step) record — at `step` when given,
+        else at the earliest affected step. None when nothing non-finite
+        was ever reported. Call jax.effects_barrier() first when the
+        step that produced the NaN may still be in flight. Step-less
+        reports (eval/forward/decode dispatches record step -1) only
+        win when NO stepped event exists — an interleaved eval NaN must
+        not outrank the training-step origin the nan_loss alert is
+        attributing."""
+        events = self.snapshot()
+        if step is not None:
+            events = [e for e in events if e["step"] == int(step)]
+        stepped = [e for e in events if e["step"] >= 0]
+        events = stepped or events
+        if not events:
+            return None
+        s0 = min(e["step"] for e in events)
+        at = [e for e in events if e["step"] == s0]
+        fwd = [e for e in at if e["phase"] == "fwd"]
+        if fwd:
+            return min(fwd, key=lambda e: e["topo"])
+        return max(at, key=lambda e: e["topo"])
+
+    def first_nonfinite(self) -> dict | None:
+        return self.localize()
+
+
+_MONITOR = NumericsMonitor()
+
+
+def get_monitor() -> NumericsMonitor:
+    return _MONITOR
+
+
+# ---------------------------------------------------------------- probes
+
+
+def _report_cb(op: str, phase: str, topo: int, finite, step):
+    # host side of the probe: drop finite reports on the floor
+    if not bool(finite):
+        _MONITOR.report(op, phase, topo, int(np.asarray(step)))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _grad_probe(x, step, op, topo):
+    return x
+
+
+def _grad_probe_fwd(x, step, op, topo):
+    return x, step
+
+
+def _grad_probe_bwd(op, topo, step, g):
+    jax.debug.callback(partial(_report_cb, op, "bwd", topo),
+                       jnp.isfinite(g).all(), step)
+    # step is an integer primal: its cotangent type is float0
+    return g, np.zeros((), dtype=jax.dtypes.float0)
+
+
+_grad_probe.defvjp(_grad_probe_fwd, _grad_probe_bwd)
+
+
+def _step_val(step):
+    # eval/forward/decode paths carry no step counter: report as -1
+    return jnp.int32(-1) if step is None else step
+
+
+def probe(x, step, op: str, topo: int):
+    """Instrument one op output: finiteness callback on the forward
+    value, custom_vjp twin on its cotangent. Identity on the value."""
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+        return x
+    s = _step_val(step)
+    jax.debug.callback(partial(_report_cb, op, "fwd", topo),
+                       jnp.isfinite(x).all(), s)
+    return _grad_probe(x, s, op, topo)
+
+
+# ------------------------------------------------------- fault injection
+
+
+def inject_nonfinite(x, step, at_step: int):
+    """Forward fault injector: the tensor becomes NaN from `at_step` on
+    (always, when no step counter is threaded — eval/decode paths)."""
+    if step is None:
+        return jnp.full_like(x, jnp.nan)
+    return jnp.where(step >= jnp.int32(at_step),
+                     jnp.asarray(jnp.nan, x.dtype), x)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def inject_grad_nonfinite(x, step, at_step: int):
+    """Backward fault injector: identity forward; the output's cotangent
+    is multiplied into NaN from `at_step` on."""
+    return x
+
+
+def _inject_grad_fwd(x, step, at_step):
+    return x, _step_val(step)
+
+
+def _inject_grad_bwd(at_step, step, g):
+    bad = jnp.where(step >= jnp.int32(at_step),
+                    jnp.asarray(jnp.nan, g.dtype),
+                    jnp.asarray(1, g.dtype))
+    return g * bad, np.zeros((), dtype=jax.dtypes.float0)
+
+
+inject_grad_nonfinite.defvjp(_inject_grad_fwd, _inject_grad_bwd)

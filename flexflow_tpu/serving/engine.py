@@ -106,6 +106,14 @@ class ServingEngine:
                     f"{self._token_input!r} and {t.name!r}")
         if self._token_input is None:
             raise ValueError("serving: model has no token input")
+        # sanitizer baseline: events reported before this engine existed
+        # (e.g. a training NaN earlier in the process) are not decode
+        # corruption — only NEW reports surface as serve.nonfinite
+        if self.decode_model.config.sanitize_numerics:
+            from ..sanitize import get_monitor
+
+            self._numerics_reported = {
+                (e["op"], e["phase"]) for e in get_monitor().snapshot()}
         # run accounting (stats())
         self._decode_iterations = 0
         self._decode_tokens = 0
@@ -193,7 +201,36 @@ class ServingEngine:
             jnp.asarray(temp))
         out = np.asarray(jax.device_get(next_tok))
         self._device_s += time.perf_counter() - t0
+        if dec.config.sanitize_numerics:
+            self._check_numerics()
         return out
+
+    def _check_numerics(self):
+        """Sanitizer check after a decode step (--sanitize-numerics):
+        the token fetch above already drained the step, so the probe
+        callbacks have fired; any new non-finite report is surfaced
+        once per op as a serve.nonfinite event + error log instead of
+        silently sampling from a NaN'd logits row."""
+        import jax
+
+        from ..sanitize import get_monitor
+        from ..telemetry import log as fflog
+
+        jax.effects_barrier()
+        events = get_monitor().snapshot()
+        seen = getattr(self, "_numerics_reported", set())
+        for e in events:
+            key = (e["op"], e["phase"])
+            if key in seen:
+                continue
+            seen.add(key)
+            telemetry.event("serve.nonfinite", op=e["op"],
+                            phase=e["phase"])
+            fflog.error(
+                "serving: non-finite tensor at op %s (%s) during "
+                "decode — the KV cache or weights are numerically "
+                "dead", e["op"], e["phase"])
+        self._numerics_reported = seen
 
     # ------------------------------------------------------------ prefill
 

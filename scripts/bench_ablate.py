@@ -54,7 +54,9 @@ def main():
             lval, (logits, new_state, ce_sum) = loss_fn(params)
             return params, state, opt_slots, step + 1, counters, lval
 
-        step_fn = jax.jit(fwd_step)
+        # single-process ablation harness: the env var SELECTS the bench
+        # variant by design; no fleet to diverge
+        step_fn = jax.jit(fwd_step)  # fflint: ok host_divergent_branch
     else:
         step_fn = ex.build_train_step()
 

@@ -14,7 +14,10 @@ Three jobs (docs/analysis.md):
    (axis reuse, dropped parallel op → implicit reshard, oversharded
    dim, non-bijective ring permutation, donated-then-reused buffer,
    coordinator-only collective) and assert the verifier reports exactly
-   that finding class.
+   that finding class. The ffsan classes ride the same matrix:
+   dtype mismatch across a parallel edge, fp32-master bypass,
+   low-precision accumulation (graph- and source-level), and a
+   host-divergent branch feeding traced code.
 
 3. **Smoke suites** (`--suite longcontext`, `--suite wus`): compile the
    long-context ring plan and the memory-constrained weight-update-
@@ -124,6 +127,10 @@ def _check_clean(ff, source: str) -> dict:
     if errs:
         fail(f"source {source}: plan verification errors: "
              f"{[str(f) for f in errs[:5]]}")
+    missing = {"dtype_flow", "spmd_uniformity"} - set(res.passes_run)
+    if missing:
+        fail(f"source {source}: ffsan passes did not run: "
+             f"{sorted(missing)}")
     print(f"ffcheck: source {source:10s} — clean "
           f"({len(res.findings)} finding(s), "
           f"{res.elapsed_s * 1e3:.0f} ms)")
@@ -208,6 +215,20 @@ def save_plan(payload):
     if is_coordinator():
         write(payload)
         barrier("plan-committed")
+"""
+
+_LP_ACCUM_SNIPPET = """
+def bad_loss(logits):
+    import jax.numpy as jnp
+    return jnp.sum(logits.astype(jnp.bfloat16)) / logits.shape[0]
+"""
+
+_DIVERGENT_SNIPPET = """
+def maybe_recompile(model, fn):
+    import time
+    if time.perf_counter() - model.t0 > 60.0:
+        return jit(fn)
+    return fn
 """
 
 
@@ -306,6 +327,98 @@ def run_self_test(workdir: str) -> list[dict]:
         _COORD_SNIPPET, "snippet.py",
         select=("coordinator_collective",))]
     check("coordinator_collective", codes, "coordinator_collective")
+
+    # --- ffsan classes (dtype-flow + SPMD uniformity) ---
+    import dataclasses
+
+    from flexflow_tpu.analysis import numerics
+
+    # 7) dtype mismatch across a parallel edge: flip a Combine/
+    # Repartition output dtype (synthesized mini-graph — a searched
+    # plan need not contain explicit parallel ops)
+    from flexflow_tpu.fftype import DataType, OperatorType as OT
+    from flexflow_tpu.parallel.ops import CombineParams
+    from flexflow_tpu.pcg.graph import Graph, OpNode
+    from flexflow_tpu.tensor import ParallelTensor, ParallelTensorShape
+
+    def _pt(shape, dtype):
+        return ParallelTensor(
+            ParallelTensorShape.from_shape(shape, dtype))
+
+    g2 = Graph()
+    src = g2.add_node(OpNode(OT.OP_INPUT, None, name="x"))
+    src.outputs = [_pt((8, 8), DataType.DT_BFLOAT16)]
+    comb = g2.add_node(OpNode(OT.OP_COMBINE, CombineParams(0, 2),
+                              name="combine"))
+    comb.inputs = [src.outputs[0]]
+    comb.outputs = [_pt((8, 8), DataType.DT_FLOAT)]
+    g2.add_edge(src, comb)
+    codes = [f.code for f in numerics.run(g2, ff.mesh, ctx)]
+    check("parallel_dtype_mismatch", codes, "parallel_dtype_mismatch")
+
+    # 8) fp32-master bypass: flip one trainable weight to bf16 under
+    # the bf16 policy
+    node = next(n for n in ff.graph.topo_order()
+                if any(ws.trainable for ws in n.weight_specs))
+    idx = next(i for i, ws in enumerate(node.weight_specs)
+               if ws.trainable)
+    saved_ws = node.weight_specs[idx]
+    saved_cd = ff.config.computation_dtype
+    node.weight_specs[idx] = dataclasses.replace(
+        saved_ws, dtype=DataType.DT_BFLOAT16)
+    ff.config.computation_dtype = DataType.DT_BFLOAT16
+    try:
+        res = run_analysis(ff.graph, ff.mesh, ctx)
+    finally:
+        node.weight_specs[idx] = saved_ws
+        ff.config.computation_dtype = saved_cd
+    check("master_bypass", [f.code for f in res.findings],
+          "master_bypass")
+
+    # 9) low-precision accumulation: a bf16 Reduce over 64k terms
+    # (graph-level) and a bf16-pinned jnp.sum (source-level)
+    g3 = Graph()
+    src3 = g3.add_node(OpNode(OT.OP_INPUT, None, name="acts"))
+    src3.outputs = [_pt((64, 1024), DataType.DT_BFLOAT16)]
+    from flexflow_tpu.ops import ReduceParams
+
+    red = g3.add_node(OpNode(
+        OT.OP_REDUCE_SUM, ReduceParams(OT.OP_REDUCE_SUM, (0, 1)),
+        name="big_sum"))
+    red.inputs = [src3.outputs[0]]
+    red.outputs = [_pt((1,), DataType.DT_BFLOAT16)]
+    g3.add_edge(src3, red)
+    codes = [f.code for f in numerics.run(g3, ff.mesh, ctx)]
+    check("low_precision_accum_graph", codes, "low_precision_accum")
+    codes = [f.code for f in lint.lint_source(
+        _LP_ACCUM_SNIPPET, "snippet.py",
+        select=("low_precision_accum",))]
+    check("low_precision_accum_src", codes, "low_precision_accum")
+
+    # 10) host-divergent branch feeding traced code (source-level)
+    codes = [f.code for f in lint.lint_source(
+        _DIVERGENT_SNIPPET, "snippet.py",
+        select=("host_divergent_branch",))]
+    check("host_divergent_branch", codes, "host_divergent_branch")
+
+    # 11) SPMD fingerprint barrier catches a diverged fleet (simulated
+    # second process via an injected broadcast channel)
+    from flexflow_tpu.analysis import spmd
+
+    verdict = spmd.fingerprint_barrier(
+        ff, broadcast=lambda p: p)  # lockstep fleet: OK
+    if verdict["status"] != "ok":
+        fail(f"self-test fingerprint_barrier: lockstep verdict "
+             f"{verdict!r}")
+    try:
+        spmd.fingerprint_barrier(
+            ff, broadcast=lambda p: {"fingerprint": "divergent"})
+    except spmd.SPMDDivergenceError:
+        check("spmd_fingerprint_mismatch", ["spmd_divergence"],
+              "spmd_divergence")
+    else:
+        fail("self-test fingerprint_barrier: divergent fleet "
+             "not detected")
     return results
 
 

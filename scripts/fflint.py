@@ -12,6 +12,12 @@ analysis.md has the catalog):
   coordinator_collective   a collective inside an is_coordinator() branch
   donated_reuse            donated step buffer read host-side after the
                            call without rebinding
+  low_precision_accum      a summing reduction explicitly accumulating
+                           in bf16/fp16 (f32-accumulate-then-downcast is
+                           the codebase convention)
+  host_divergent_branch    per-host-nondeterministic branch (time/RNG/
+                           env/hostname) guarding a collective or a
+                           trace entry — the r13 divergence class
 
 Suppression: trailing `# fflint: ok [codes]` on the line or its `def`.
 

@@ -1,0 +1,194 @@
+"""ffsan smoke: the numerics-verifier + NaN-provenance CI gate.
+
+Three assertions (docs/analysis.md "ffsan"):
+
+1. **Static half present and clean** — compile a mixed-precision (bf16)
+   transformer LM with --diagnostics + --sanitize-numerics +
+   --spmd-barrier and assert strategy_report.json carries the
+   `analysis` section with the `dtype_flow` and `spmd_uniformity`
+   passes run, ZERO errors, `sanitize_numerics: true`, and a
+   non-diverged barrier verdict; the warm dtype-flow pass itself must
+   stay inside its compile-overhead budget.
+
+2. **NaN provenance** — inject a non-finite value at a named op at step
+   K (the executor's numeric-fault hook) and assert the ONE `nan_loss`
+   alert in alerts.jsonl names exactly that op and phase — "op X's fwd
+   went non-finite at step K", not just "loss is NaN".
+
+3. **run_doctor gate** — the artifacts still pass `run_doctor --check`
+   (which now also gates on the ffsan report fields).
+
+Routed through the pipelined engine with --pipeline-steps N (the
+localization must survive the fused lax.scan dispatch).
+
+Usage: python scripts/ffsan_smoke.py --telemetry-dir DIR
+       [--pipeline-steps N] [--report OUT.json]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+FAULT_STEP = 3
+
+
+def fail(msg: str):
+    print(f"ffsan_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    argv = sys.argv[1:]
+    telemetry_dir = "ffsan-artifacts"
+    pipeline_steps = 1
+    report_path = ""
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--telemetry-dir":
+            i += 1
+            telemetry_dir = argv[i]
+        elif a == "--pipeline-steps":
+            i += 1
+            pipeline_steps = int(argv[i])
+        elif a == "--report":
+            i += 1
+            report_path = argv[i]
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return
+        else:
+            fail(f"unknown flag {a!r}")
+        i += 1
+    sys.argv = [sys.argv[0]]  # FFConfig must not parse our flags
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.fftype import DataType, OperatorType as OT
+    from flexflow_tpu.models import TransformerLMConfig, build_transformer_lm
+
+    cfg = FFConfig()
+    cfg.mesh_axis_sizes = (2, 1, 1, 1)
+    cfg.batch_size = 4
+    cfg.computation_dtype = DataType.DT_BFLOAT16
+    cfg.sanitize_numerics = True
+    cfg.spmd_barrier = True
+    cfg.diagnostics = True
+    cfg.telemetry_dir = telemetry_dir
+    cfg.pipeline_steps = pipeline_steps
+    ff = FFModel(cfg)
+    lm = TransformerLMConfig(vocab_size=64, hidden_size=32, num_heads=2,
+                             num_layers=1, sequence_length=16)
+    build_transformer_lm(ff, lm, batch_size=4)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    # ---- 1) static half: report fields + clean numerics section
+    rpath = os.path.join(telemetry_dir, "strategy_report.json")
+    if not os.path.exists(rpath):
+        fail(f"no {rpath} (diagnostics did not write the report)")
+    rep = json.load(open(rpath))
+    analysis = rep.get("analysis")
+    if analysis is None:
+        fail("strategy_report.json has no analysis section")
+    for p in ("dtype_flow", "spmd_uniformity"):
+        if p not in analysis.get("passes_run", []):
+            fail(f"pass {p} did not run (got "
+                 f"{analysis.get('passes_run')})")
+    if analysis["errors"]:
+        errs = [f for f in analysis["findings"]
+                if f["severity"] == "error"]
+        fail(f"mixed-precision compile has analysis errors: {errs[:3]}")
+    num_findings = [f for f in analysis["findings"]
+                    if f["pass"] in ("dtype_flow", "spmd_uniformity")
+                    and f["severity"] != "info"]
+    if num_findings:
+        fail(f"ffsan passes not clean on the bf16 LM: {num_findings}")
+    if not rep.get("sanitize_numerics"):
+        fail("report does not record sanitize_numerics: true")
+    if rep.get("spmd_barrier") not in ("ok", "single_process"):
+        fail(f"barrier verdict {rep.get('spmd_barrier')!r}")
+    # warm-pass budget: source scans are cached per process, so a warm
+    # dtype-flow pass is a pure graph walk — time it standalone
+    from flexflow_tpu.analysis import context_for_model, numerics
+
+    ctx = context_for_model(ff)
+    best = min(_timed(numerics.run, ff.graph, ff.mesh, ctx)
+               for _ in range(3))
+    if best > 0.005:
+        fail(f"warm dtype_flow pass took {best * 1e3:.1f} ms (> 5 ms)")
+    print(f"ffsan_smoke: static half clean "
+          f"(dtype_flow warm {best * 1e3:.2f} ms, barrier "
+          f"{rep['spmd_barrier']})")
+
+    # ---- 2) NaN provenance: poison one op's fwd at step FAULT_STEP
+    target = next((n.name for n in ff.graph.topo_order()
+                   if n.op_type == OT.OP_MULTIHEAD_ATTENTION),
+                  None) or next(
+        n.name for n in ff.graph.topo_order()
+        if n.op_type == OT.OP_LINEAR)
+    ff.executor.set_numeric_fault(target, "fwd", FAULT_STEP)
+    rs = np.random.RandomState(0)
+    n = 32
+    X = {"tokens": rs.randint(0, lm.vocab_size,
+                              (n, lm.sequence_length)).astype(np.int32),
+         "positions": np.tile(np.arange(lm.sequence_length,
+                                        dtype=np.int32), (n, 1))}
+    Y = rs.randint(0, lm.vocab_size,
+                   (n, lm.sequence_length, 1)).astype(np.int32)
+    ff.fit(X, Y, epochs=1, batch_size=4, shuffle=False, verbose=False)
+
+    apath = os.path.join(telemetry_dir, "alerts.jsonl")
+    alerts = [json.loads(line) for line in open(apath)
+              if line.strip()]
+    nan_alerts = [a for a in alerts if a.get("rule") == "nan_loss"]
+    if len(nan_alerts) != 1:
+        fail(f"expected exactly one nan_loss alert (fire-once), got "
+             f"{len(nan_alerts)}")
+    alert = nan_alerts[0]
+    details = alert.get("details") or {}
+    if details.get("op") != target or details.get("phase") != "fwd":
+        fail(f"alert does not name the poisoned op: wanted "
+             f"({target!r}, fwd), got {details!r} "
+             f"[{alert.get('message')}]")
+    if int(details.get("at_step", -1)) != FAULT_STEP:
+        fail(f"alert localizes step {details.get('at_step')} "
+             f"!= injected {FAULT_STEP}")
+    print(f"ffsan_smoke: nan_loss alert names {target} (fwd) at step "
+          f"{FAULT_STEP} — provenance OK "
+          f"(pipeline_steps={pipeline_steps})")
+
+    if report_path:
+        os.makedirs(os.path.dirname(os.path.abspath(report_path)),
+                    exist_ok=True)
+        with open(report_path, "w") as f:
+            json.dump({"kind": "ffsan_report", "ok": True,
+                       "dtype_flow_warm_s": best,
+                       "spmd_barrier": rep["spmd_barrier"],
+                       "localized": details,
+                       "pipeline_steps": pipeline_steps}, f, indent=1)
+        print(f"ffsan_smoke: report written to {report_path}")
+    print("ffsan_smoke: OK")
+
+
+def _timed(fn, *args):
+    t0 = time.perf_counter()
+    fn(*args)
+    return time.perf_counter() - t0
+
+
+if __name__ == "__main__":
+    main()

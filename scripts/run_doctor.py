@@ -59,6 +59,22 @@ def main():
                     f"strategy_report per-op costs ({total}) do not "
                     f"reproduce total_predicted_s "
                     f"({rep['total_predicted_s']}) under the makespan rule")
+            # ffsan gates: the numerics + SPMD passes must have run in
+            # the compile gate, and a recorded fingerprint-barrier
+            # mismatch means the artifacts describe a diverged fleet
+            analysis = rep.get("analysis")
+            if analysis is not None:
+                for p in ("dtype_flow", "spmd_uniformity"):
+                    if p not in analysis.get("passes_run", []):
+                        problems.append(
+                            f"analysis section missing the {p} pass "
+                            f"(ffsan did not run in the compile gate)")
+            if rep.get("spmd_barrier") not in (
+                    None, "off", "ok", "single_process"):
+                problems.append(
+                    f"SPMD fingerprint barrier verdict "
+                    f"{rep.get('spmd_barrier')!r} — the fleet diverged "
+                    f"before the first step")
         if problems:
             print("run_doctor: CHECK FAILED: " + "; ".join(problems),
                   file=sys.stderr)

@@ -158,7 +158,8 @@ def test_fuzzer_clean_baseline(searched):
     assert res.ok, [str(f) for f in res.errors()]
     assert res.passes_run == ["sharding_dataflow", "memory_liveness",
                               "collective_uniformity",
-                              "donation_aliasing"]
+                              "donation_aliasing", "dtype_flow",
+                              "spmd_uniformity"]
 
 
 def test_fuzzer_axis_reuse(searched):
@@ -599,7 +600,8 @@ def test_report_carries_analysis_section(tmp_path):
     assert a["errors"] == 0
     assert a["passes_run"] == ["sharding_dataflow", "memory_liveness",
                                "collective_uniformity",
-                               "donation_aliasing"]
+                               "donation_aliasing", "dtype_flow",
+                               "spmd_uniformity"]
     assert any(f["code"] == "memory_timeline" for f in a["findings"])
 
 

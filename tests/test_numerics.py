@@ -1,0 +1,674 @@
+"""ffsan tests: dtype-flow numerics verifier, NaN-provenance sanitizer,
+SPMD divergence detector (analysis/numerics.py, analysis/spmd.py,
+sanitize.py; docs/analysis.md "ffsan").
+
+The acceptance matrix of ISSUE 10: injected-NaN localization per op
+class (matmul / attention / layernorm / loss, fwd AND bwd, eager AND
+--pipeline-steps 4), dtype-lattice unit tests for every finding code,
+a clean-model zero-finding sweep, fingerprint-barrier mismatch
+detection on a simulated 2-process run, and sanitizer-off bit-identity
+with the uninstrumented step.
+"""
+
+import json
+import math
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _config(argv=()):
+    sys.argv = ["test"] + list(argv)
+    from flexflow_tpu import FFConfig
+
+    config = FFConfig()
+    config.batch_size = 4
+    return config
+
+
+def _compile(ff):
+    from flexflow_tpu import LossType, SGDOptimizer
+
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def _lm(config, seq=16):
+    from flexflow_tpu import FFModel
+    from flexflow_tpu.models import TransformerLMConfig, build_transformer_lm
+
+    ff = FFModel(config)
+    cfg = TransformerLMConfig(
+        vocab_size=64, hidden_size=32, num_heads=2, num_layers=1,
+        sequence_length=seq)
+    build_transformer_lm(ff, cfg, batch_size=4)
+    return ff, cfg
+
+
+def _lm_data(cfg, n=16, seed=0):
+    rs = np.random.RandomState(seed)
+    X = {"tokens": rs.randint(0, cfg.vocab_size,
+                              (n, cfg.sequence_length)).astype(np.int32),
+         "positions": np.tile(np.arange(cfg.sequence_length,
+                                        dtype=np.int32), (n, 1))}
+    Y = rs.randint(0, cfg.vocab_size,
+                   (n, cfg.sequence_length, 1)).astype(np.int32)
+    return X, Y
+
+
+def _reset_model(ff):
+    """Re-derive pristine training state (the _compile_impl tail) so a
+    NaN'd fit doesn't leak into the next test, and clear any fault."""
+    ff.executor.set_numeric_fault(None)
+    ff._rng = jax.random.key(ff.config.seed)
+    ff._params, ff._state = ff.executor.init_variables(ff._rng)
+    ff._opt_slots = ff.executor.place_update_sharded(
+        ff.executor.replicate(ff.optimizer.init(ff._params)))
+    if ff._state:
+        ff._state = ff.executor.replicate(ff._state)
+    ff._step = ff.executor.replicate(jnp.zeros((), jnp.int32))
+    ff._counters = ff.executor.replicate(ff.metrics.zero_counters())
+
+
+@pytest.fixture(scope="module")
+def lm_bf16():
+    """One sanitizer-on bf16 LM shared by the localization matrix (every
+    test resets state + fault via _reset_model)."""
+    from flexflow_tpu.fftype import DataType
+
+    cfg = _config()
+    cfg.mesh_axis_sizes = (2, 1, 1, 1)
+    cfg.computation_dtype = DataType.DT_BFLOAT16
+    cfg.sanitize_numerics = True
+    ff, lmcfg = _lm(cfg)
+    return _compile(ff), lmcfg
+
+
+def _node_of(ff, op_type):
+    from flexflow_tpu.fftype import OperatorType as OT
+
+    return next(n.name for n in ff.graph.topo_order()
+                if n.op_type == op_type)
+
+
+def _target(ff, op_class: str) -> str:
+    from flexflow_tpu.fftype import OperatorType as OT
+
+    return {"matmul": lambda: _node_of(ff, OT.OP_LINEAR),
+            "attention": lambda: _node_of(ff, OT.OP_MULTIHEAD_ATTENTION),
+            "layernorm": lambda: _node_of(ff, OT.OP_LAYERNORM),
+            "loss": lambda: "loss"}[op_class]()
+
+
+# ============================== 1) injected-NaN localization matrix
+
+
+@pytest.mark.parametrize("pipeline", [1, 4],
+                         ids=["eager", "pipelined4"])
+@pytest.mark.parametrize("phase", ["fwd", "bwd"])
+@pytest.mark.parametrize("op_class",
+                         ["matmul", "attention", "layernorm", "loss"])
+def test_nan_localization(lm_bf16, op_class, phase, pipeline):
+    from flexflow_tpu import sanitize
+
+    ff, lmcfg = lm_bf16
+    _reset_model(ff)
+    target = _target(ff, op_class)
+    fault_step = 2  # device-step numbering (0-based), mid-chunk for n=4
+    ff.executor.set_numeric_fault(target, phase, fault_step)
+    sanitize.get_monitor().reset()
+    X, Y = _lm_data(lmcfg)
+    ff.fit(X, Y, epochs=1, batch_size=4, shuffle=False, verbose=False,
+           pipeline_steps=pipeline)
+    jax.effects_barrier()
+    info = sanitize.get_monitor().first_nonfinite()
+    assert info is not None, (
+        f"{op_class}/{phase}/pipeline={pipeline}: nothing localized")
+    assert info["op"] == target, info
+    assert info["phase"] == phase, info
+    assert info["step"] == fault_step, info
+
+
+def test_localization_clean_run_reports_nothing(lm_bf16):
+    from flexflow_tpu import sanitize
+
+    ff, lmcfg = lm_bf16
+    _reset_model(ff)
+    sanitize.get_monitor().reset()
+    X, Y = _lm_data(lmcfg)
+    ff.fit(X, Y, epochs=1, batch_size=4, shuffle=False, verbose=False)
+    jax.effects_barrier()
+    assert sanitize.get_monitor().first_nonfinite() is None
+
+
+def test_fit_resets_stale_monitor_state(lm_bf16):
+    """Same-process retry: a NaN'd fit must not leak its reports into
+    the next fit's localization — fit starts a fresh provenance
+    window."""
+    from flexflow_tpu import sanitize
+
+    ff, lmcfg = lm_bf16
+    _reset_model(ff)
+    target = _target(ff, "matmul")
+    ff.executor.set_numeric_fault(target, "fwd", 0)
+    X, Y = _lm_data(lmcfg)
+    ff.fit(X, Y, epochs=1, batch_size=4, shuffle=False, verbose=False)
+    jax.effects_barrier()
+    assert sanitize.get_monitor().first_nonfinite() is not None
+    # retry WITHOUT a manual monitor reset: the clean fit must see a
+    # clean monitor
+    _reset_model(ff)
+    ff.fit(X, Y, epochs=1, batch_size=4, shuffle=False, verbose=False)
+    jax.effects_barrier()
+    assert sanitize.get_monitor().first_nonfinite() is None
+
+
+def test_localize_prefers_stepped_events_over_stepless():
+    """An interleaved eval NaN (step -1) must not outrank the training
+    step the nan_loss alert is attributing; step-less events only win
+    when nothing stepped exists."""
+    from flexflow_tpu.sanitize import NumericsMonitor
+
+    mon = NumericsMonitor()
+    mon.report("eval_op", "fwd", 1, -1)
+    mon.report("train_op", "fwd", 2, 5)
+    info = mon.first_nonfinite()
+    assert (info["op"], info["step"]) == ("train_op", 5)
+    mon2 = NumericsMonitor()
+    mon2.report("eval_op", "fwd", 1, -1)
+    assert mon2.first_nonfinite()["op"] == "eval_op"
+
+
+def test_localization_stepless_paths(lm_bf16):
+    """eval/forward/decode run _apply without a step counter — probes
+    report step -1 (the serving engine's serve.nonfinite check reads
+    the same monitor)."""
+    from flexflow_tpu import sanitize
+    from flexflow_tpu.fftype import OperatorType as OT
+
+    ff, lmcfg = lm_bf16
+    _reset_model(ff)
+    target = next(n.name for n in ff.graph.topo_order()
+                  if n.op_type == OT.OP_LAYERNORM)
+    ff.executor.set_numeric_fault(target, "fwd", 0)
+    sanitize.get_monitor().reset()
+    X, Y = _lm_data(lmcfg, n=4)
+    ff.eval(X, Y, batch_size=4)
+    jax.effects_barrier()
+    info = sanitize.get_monitor().first_nonfinite()
+    assert info is not None
+    assert (info["op"], info["phase"], info["step"]) == \
+        (target, "fwd", -1)
+
+
+# ============================== 2) dtype-lattice unit tests
+
+
+def _pt(shape, dtype):
+    from flexflow_tpu.tensor import ParallelTensor, ParallelTensorShape
+
+    return ParallelTensor(ParallelTensorShape.from_shape(shape, dtype))
+
+
+def _chain(*nodes_and_outputs):
+    """Build a linear graph from (op_type, params, name, out_shape,
+    out_dtype) tuples."""
+    from flexflow_tpu.pcg.graph import Graph, OpNode
+
+    g = Graph()
+    prev = None
+    for op_type, params, name, shape, dtype in nodes_and_outputs:
+        node = g.add_node(OpNode(op_type, params, name=name))
+        node.outputs = [_pt(shape, dtype)]
+        if prev is not None:
+            node.inputs = [prev.outputs[0]]
+            g.add_edge(prev, node)
+        prev = node
+    return g
+
+
+@pytest.fixture
+def mesh8():
+    from flexflow_tpu.machine import MeshShape, build_mesh
+
+    return build_mesh(MeshShape((2, 4, 1, 1),
+                                ("data", "model", "pipe", "seq")))
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+def test_lattice_parallel_dtype_mismatch(mesh8):
+    from flexflow_tpu.analysis import numerics
+    from flexflow_tpu.fftype import DataType, OperatorType as OT
+    from flexflow_tpu.parallel.ops import CombineParams
+
+    g = _chain(
+        (OT.OP_INPUT, None, "x", (8, 8), DataType.DT_BFLOAT16),
+        (OT.OP_COMBINE, CombineParams(0, 2), "combine", (8, 8),
+         DataType.DT_FLOAT))
+    findings = numerics.run(g, mesh8, None)
+    assert "parallel_dtype_mismatch" in _codes(findings)
+    f = next(x for x in findings if x.code == "parallel_dtype_mismatch")
+    assert f.severity == "error"
+
+
+def test_lattice_low_precision_accum_reduce(mesh8):
+    from flexflow_tpu.analysis import numerics
+    from flexflow_tpu.fftype import DataType, OperatorType as OT
+    from flexflow_tpu.ops import ReduceParams
+
+    g = _chain(
+        (OT.OP_INPUT, None, "x", (64, 1024), DataType.DT_BFLOAT16),
+        (OT.OP_REDUCE_SUM, ReduceParams(OT.OP_REDUCE_SUM, (0, 1)),
+         "big_sum", (1,), DataType.DT_BFLOAT16))
+    assert "low_precision_accum" in _codes(numerics.run(g, mesh8, None))
+    # a small reduce stays silent (threshold = ACCUM_ELEMS_WARN)
+    g2 = _chain(
+        (OT.OP_INPUT, None, "x", (4, 4), DataType.DT_BFLOAT16),
+        (OT.OP_REDUCE_SUM, ReduceParams(OT.OP_REDUCE_SUM, (0, 1)),
+         "small_sum", (1,), DataType.DT_BFLOAT16))
+    assert "low_precision_accum" not in _codes(
+        numerics.run(g2, mesh8, None))
+    # f32 reduces of any size stay silent
+    g3 = _chain(
+        (OT.OP_INPUT, None, "x", (64, 1024), DataType.DT_FLOAT),
+        (OT.OP_REDUCE_SUM, ReduceParams(OT.OP_REDUCE_SUM, (0, 1)),
+         "f32_sum", (1,), DataType.DT_FLOAT))
+    assert "low_precision_accum" not in _codes(
+        numerics.run(g3, mesh8, None))
+
+
+def test_lattice_low_precision_accum_reduction_partial_sums(mesh8):
+    from flexflow_tpu.analysis import numerics
+    from flexflow_tpu.fftype import DataType, OperatorType as OT
+    from flexflow_tpu.parallel.ops import ReductionParams
+
+    g = _chain(
+        (OT.OP_INPUT, None, "x", (8, 8), DataType.DT_BFLOAT16),
+        (OT.OP_REDUCTION, ReductionParams(64), "wide_psum", (8, 8),
+         DataType.DT_BFLOAT16))
+    assert "low_precision_accum" in _codes(numerics.run(g, mesh8, None))
+    # a narrow partial sum (degree < ACCUM_TERMS_WARN) stays silent
+    g2 = _chain(
+        (OT.OP_INPUT, None, "x", (8, 8), DataType.DT_BFLOAT16),
+        (OT.OP_REDUCTION, ReductionParams(4), "narrow_psum", (8, 8),
+         DataType.DT_BFLOAT16))
+    assert "low_precision_accum" not in _codes(
+        numerics.run(g2, mesh8, None))
+
+
+def test_lattice_low_precision_grad_reduce_scatter(mesh8):
+    from jax.sharding import PartitionSpec
+
+    from flexflow_tpu.analysis import AnalysisContext, numerics
+    from flexflow_tpu.fftype import DataType, OperatorType as OT
+    from flexflow_tpu.ops.base import WeightSpec
+    from flexflow_tpu.pcg.graph import Graph, OpNode
+
+    g = Graph()
+    node = g.add_node(OpNode(OT.OP_LINEAR, None, name="fc"))
+    node.outputs = [_pt((8, 8), DataType.DT_FLOAT)]
+    node.weight_specs = [WeightSpec("kernel", (8, 8),
+                                    DataType.DT_BFLOAT16)]
+    ctx = AnalysisContext(update_specs={
+        ("fc", "kernel"): (PartitionSpec("data"), (8, 8))})
+    findings = numerics.run(g, mesh8, ctx)
+    f = next(x for x in findings if x.code == "low_precision_accum")
+    assert "reduce-scatter" in f.message
+
+
+def test_lattice_master_bypass(mesh8):
+    from flexflow_tpu.analysis import AnalysisContext, numerics
+    from flexflow_tpu.fftype import DataType, OperatorType as OT
+    from flexflow_tpu.ops.base import WeightSpec
+    from flexflow_tpu.pcg.graph import Graph, OpNode
+
+    g = Graph()
+    node = g.add_node(OpNode(OT.OP_LINEAR, None, name="fc"))
+    node.outputs = [_pt((8, 8), DataType.DT_FLOAT)]
+    node.weight_specs = [WeightSpec("kernel", (8, 8),
+                                    DataType.DT_BFLOAT16)]
+    cfg = _config()
+    cfg.computation_dtype = DataType.DT_BFLOAT16
+    findings = numerics.run(g, mesh8,
+                            AnalysisContext(config=cfg, training=True))
+    f = next(x for x in findings if x.code == "master_bypass")
+    assert f.severity == "error"
+    # inference compiles carry no master-weight invariant
+    assert "master_bypass" not in _codes(numerics.run(
+        g, mesh8, AnalysisContext(config=cfg, training=False)))
+    # fp32 weights under the same policy are the correct master path
+    node.weight_specs = [WeightSpec("kernel", (8, 8),
+                                    DataType.DT_FLOAT)]
+    assert "master_bypass" not in _codes(numerics.run(
+        g, mesh8, AnalysisContext(config=cfg, training=True)))
+
+
+def test_lattice_downcast_roundtrip(mesh8):
+    from flexflow_tpu.analysis import numerics
+    from flexflow_tpu.fftype import DataType, OperatorType as OT
+    from flexflow_tpu.ops import CastParams, ReshapeParams
+
+    g = _chain(
+        (OT.OP_INPUT, None, "x", (8, 8), DataType.DT_FLOAT),
+        (OT.OP_CAST, CastParams(DataType.DT_BFLOAT16), "down", (8, 8),
+         DataType.DT_BFLOAT16),
+        (OT.OP_RESHAPE, ReshapeParams((64,)), "view", (64,),
+         DataType.DT_BFLOAT16),
+        (OT.OP_CAST, CastParams(DataType.DT_FLOAT), "up", (64,),
+         DataType.DT_FLOAT))
+    f = next(x for x in numerics.run(g, mesh8, None)
+             if x.code == "downcast_roundtrip")
+    assert f.details["upcast_at"] == "up"
+
+
+def test_lattice_clean_graph_single_info(mesh8):
+    from flexflow_tpu.analysis import numerics
+    from flexflow_tpu.fftype import DataType, OperatorType as OT
+
+    g = _chain((OT.OP_INPUT, None, "x", (8, 8), DataType.DT_FLOAT),
+               (OT.OP_RELU, None, "act", (8, 8), DataType.DT_FLOAT))
+    findings = numerics.run(g, mesh8, None)
+    assert _codes(findings) == ["numerics_clean"]
+    assert findings[0].severity == "info"
+
+
+# ============================== 3) clean-model zero-finding sweep
+
+
+def test_clean_sweep_bf16_lm(lm_bf16):
+    ff, _ = lm_bf16
+    res = ff._analysis
+    assert res is not None
+    assert {"dtype_flow", "spmd_uniformity"} <= set(res.passes_run)
+    ffsan = [f for f in res.findings
+             if f.pass_name in ("dtype_flow", "spmd_uniformity")]
+    assert ffsan, "ffsan passes reported nothing at all"
+    assert all(f.severity == "info" for f in ffsan), [
+        str(f) for f in ffsan if f.severity != "info"]
+
+
+def test_clean_sweep_fp32_searched():
+    ff, _ = _lm(_config(["--mesh", "2,4,1,1", "--budget", "4",
+                         "--enable-parameter-parallel"]))
+    _compile(ff)
+    ffsan = [f for f in ff._analysis.findings
+             if f.pass_name in ("dtype_flow", "spmd_uniformity")]
+    assert all(f.severity == "info" for f in ffsan), [
+        str(f) for f in ffsan if f.severity != "info"]
+
+
+# ============================== 4) lint rules
+
+
+def _lint(src, select):
+    from flexflow_tpu.analysis import lint
+
+    return [f.code for f in lint.lint_source(src, "snippet.py",
+                                             select=select)]
+
+
+def test_lint_low_precision_accum():
+    bad = """
+def f(x):
+    import jax.numpy as jnp
+    return jnp.sum(x.astype(jnp.bfloat16))
+"""
+    assert _lint(bad, ("low_precision_accum",)) == \
+        ["low_precision_accum"]
+    bad_kw = """
+def f(x):
+    import jax.numpy as jnp
+    return jnp.mean(x, dtype=jnp.float16)
+"""
+    assert _lint(bad_kw, ("low_precision_accum",)) == \
+        ["low_precision_accum"]
+    # f32-accumulate-then-downcast (the codebase convention) is clean
+    good = """
+def f(x):
+    import jax.numpy as jnp
+    return jnp.sum(x.astype(jnp.float32)).astype(jnp.bfloat16)
+"""
+    assert _lint(good, ("low_precision_accum",)) == []
+    # order statistics carry no accumulation error
+    assert _lint("""
+def f(x):
+    import jax.numpy as jnp
+    return jnp.max(x.astype(jnp.bfloat16))
+""", ("low_precision_accum",)) == []
+
+
+def test_lint_host_divergent_branch():
+    deadlock = """
+def f(payload):
+    import time
+    if time.perf_counter() > 100.0:
+        barrier("resync")
+"""
+    found = _lint(deadlock, ("host_divergent_branch",))
+    assert found == ["host_divergent_branch"]
+    divergent_trace = """
+def f(fn):
+    import os
+    if os.getenv("FAST"):
+        return jit(fn)
+    return fn
+"""
+    assert _lint(divergent_trace, ("host_divergent_branch",)) == \
+        ["host_divergent_branch"]
+    # the sanctioned idiom: decide via broadcast state, not local time
+    good = """
+def f(fn, decision):
+    if decision["recompile"]:
+        return jit(fn)
+    return fn
+"""
+    assert _lint(good, ("host_divergent_branch",)) == []
+
+
+def test_lint_repo_clean_for_new_rules():
+    """The CI invariant: the repo itself carries no unsuppressed
+    low_precision_accum / host_divergent_branch findings."""
+    import os
+
+    from flexflow_tpu.analysis import lint
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = lint.lint_paths(
+        [os.path.join(root, "flexflow_tpu"),
+         os.path.join(root, "scripts")],
+        select=("low_precision_accum", "host_divergent_branch"))
+    assert findings == [], [str(f) for f in findings]
+
+
+# ============================== 5) fingerprint barrier (simulated fleet)
+
+
+def test_fingerprint_barrier_lockstep_and_mismatch(lm_bf16):
+    from flexflow_tpu.analysis import spmd
+
+    ff, _ = lm_bf16
+    # single-process short-circuit (the default channel)
+    v = spmd.fingerprint_barrier(ff)
+    assert v["status"] == "single_process"
+    # simulated 2-process lockstep: the coordinator's payload comes back
+    # unchanged over the injected broadcast channel
+    v = spmd.fingerprint_barrier(ff, broadcast=lambda p: p)
+    assert v["status"] == "ok"
+    assert v["fingerprint"] == spmd.step_fingerprint(ff)
+    # simulated divergent second process
+    with pytest.raises(spmd.SPMDDivergenceError) as ei:
+        spmd.fingerprint_barrier(
+            ff, broadcast=lambda p: {
+                "fingerprint": "divergent",
+                "payload": dict(spmd.fingerprint_payload(ff),
+                                numerics="divergent")})
+    assert "numerics" in str(ei.value)
+
+
+def test_fingerprint_barrier_peer_mismatch_aborts_in_lockstep(lm_bf16):
+    """The lockstep half: a process whose OWN fingerprint matches the
+    coordinator must still abort when the gathered flags show a peer
+    diverged — otherwise the survivors hang in the next collective."""
+    from flexflow_tpu.analysis import spmd
+
+    ff, _ = lm_bf16
+    with pytest.raises(spmd.SPMDDivergenceError) as ei:
+        spmd.fingerprint_barrier(ff, broadcast=lambda p: p,
+                                 gather=lambda m: [m, False])
+    assert ei.value.peer_mismatch
+    # an all-matching fleet passes through the same two-phase path
+    v = spmd.fingerprint_barrier(ff, broadcast=lambda p: p,
+                                 gather=lambda m: [m, True])
+    assert v["status"] == "ok"
+
+
+def test_fingerprint_tracks_numerics_policy(lm_bf16):
+    from flexflow_tpu.analysis import spmd
+
+    ff, _ = lm_bf16
+    fp = spmd.step_fingerprint(ff)
+    assert fp == spmd.step_fingerprint(ff)  # deterministic
+    saved = ff.config.sanitize_numerics
+    ff.config.sanitize_numerics = not saved
+    try:
+        assert spmd.step_fingerprint(ff) != fp
+    finally:
+        ff.config.sanitize_numerics = saved
+
+
+# ============================== 6) alert enrichment (fire-once kept)
+
+
+def test_nan_loss_rule_enriched_and_fire_once():
+    from flexflow_tpu.diagnostics.health import NaNLossRule
+
+    rule = NaNLossRule()
+    alert = rule.check({"step": 7, "loss": float("nan"),
+                        "nonfinite_op": "l0_attn",
+                        "nonfinite_phase": "bwd",
+                        "nonfinite_step": 6})
+    assert alert is not None
+    assert "l0_attn" in alert.message and "backward" in alert.message
+    assert alert.details == {"op": "l0_attn", "phase": "bwd",
+                             "at_step": 6}
+    rec = alert.to_record()
+    assert rec["details"]["op"] == "l0_attn"
+    # fire-once: the dead run gets ONE alert
+    assert rule.check({"step": 8, "loss": float("nan")}) is None
+
+
+def test_nan_loss_rule_unenriched_without_sanitizer():
+    from flexflow_tpu.diagnostics.health import NaNLossRule
+
+    alert = NaNLossRule().check({"step": 3, "loss": float("inf")})
+    assert alert is not None
+    assert alert.details == {}
+    assert "first non-finite" not in alert.message
+
+
+def test_alerts_jsonl_names_op_end_to_end(tmp_path):
+    """Satellite 1 end-to-end: --sanitize-numerics + diagnostics → the
+    nan_loss record in alerts.jsonl carries the localization."""
+    from flexflow_tpu import FFModel
+    from flexflow_tpu.fftype import OperatorType as OT
+
+    cfg = _config()
+    cfg.sanitize_numerics = True
+    ff, lmcfg = _lm(cfg)
+    _compile(ff)
+    ff.enable_diagnostics(str(tmp_path))
+    target = next(n.name for n in ff.graph.topo_order()
+                  if n.op_type == OT.OP_LINEAR)
+    ff.executor.set_numeric_fault(target, "bwd", 1)
+    from flexflow_tpu import sanitize
+
+    sanitize.get_monitor().reset()
+    X, Y = _lm_data(lmcfg)
+    ff.fit(X, Y, epochs=1, batch_size=4, shuffle=False, verbose=False)
+    alerts = [json.loads(line)
+              for line in open(tmp_path / "alerts.jsonl")
+              if line.strip()]
+    nan = [a for a in alerts if a.get("rule") == "nan_loss"]
+    assert len(nan) == 1
+    assert nan[0]["details"] == {"op": target, "phase": "bwd",
+                                 "at_step": 1}
+
+
+# ============================== 7) sanitizer-off bit-identity
+
+
+def _mlp(sanitize_on: bool):
+    from flexflow_tpu import ActiMode, FFModel
+
+    cfg = _config()
+    cfg.batch_size = 8
+    cfg.sanitize_numerics = sanitize_on
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 16), name="input_0")
+    t = ff.dense(x, 32, ActiMode.AC_MODE_RELU, name="fc1")
+    ff.softmax(ff.dense(t, 8, name="head"), name="sm")
+    return _compile(ff)
+
+
+def _fit_and_flatten(ff, rng):
+    X = rng.randn(32, 16).astype(np.float32)
+    Y = rng.randint(0, 8, (32, 1)).astype(np.int32)
+    ff.fit(X, Y, epochs=1, batch_size=8, shuffle=False, verbose=False)
+    return jax.tree_util.tree_leaves(jax.device_get(ff._params))
+
+
+def test_sanitizer_off_and_on_bit_identical():
+    """Off: the traced step is the uninstrumented one (HEAD behavior).
+    On: the probes are effectful identities — the training trajectory
+    stays BIT-identical, so the flag can be flipped on a production run
+    without changing its math."""
+    base = _fit_and_flatten(_mlp(False), np.random.RandomState(0))
+    off2 = _fit_and_flatten(_mlp(False), np.random.RandomState(0))
+    on = _fit_and_flatten(_mlp(True), np.random.RandomState(0))
+    for a, b in zip(base, off2):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(base, on):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# ============================== 8) report & doctor surface
+
+
+def test_strategy_report_carries_ffsan_fields(tmp_path):
+    cfg = _config()
+    cfg.sanitize_numerics = True
+    cfg.spmd_barrier = True
+    ff, _ = _lm(cfg)
+    _compile(ff)
+    ff.enable_diagnostics(str(tmp_path))
+    ff.get_diagnostics().on_compile()
+    rep = json.load(open(tmp_path / "strategy_report.json"))
+    assert rep["sanitize_numerics"] is True
+    assert rep["spmd_barrier"] == "single_process"
+    assert {"dtype_flow", "spmd_uniformity"} <= set(
+        rep["analysis"]["passes_run"])
+
+
+def test_dtype_flow_warm_under_budget(lm_bf16):
+    """Acceptance: the static numerics pass adds <5 ms to a warm
+    compile (source scans cached per process, pure graph walk)."""
+    import time
+
+    from flexflow_tpu.analysis import context_for_model, numerics
+
+    ff, _ = lm_bf16
+    ctx = context_for_model(ff)
+    numerics.run(ff.graph, ff.mesh, ctx)  # warm any lazy imports
+    best = math.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        numerics.run(ff.graph, ff.mesh, ctx)
+        best = min(best, time.perf_counter() - t0)
+    assert best < 0.005, f"dtype_flow warm pass took {best * 1e3:.2f} ms"
