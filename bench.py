@@ -513,14 +513,18 @@ def _warmstart_legs() -> dict:
 
 
 def _serving_legs(cfg, on_tpu: bool) -> dict:
-    """Serving leg: requests/s/chip + decode tokens/s/chip through the
+    """Serving legs: requests/s/chip + decode tokens/s/chip through the
     continuous-batching engine (serving/) — the ROADMAP's "millions of
-    users" metric next to the training slope. The engine compiles the
-    decode graph from the same PCG, then drains a synthetic request queue
-    (prompt 8, 16 new tokens each) through a fixed slot set; the decode
-    executables are warmed by one throwaway request so the measured drain
-    is steady-state continuous batching. scripts/serve_bench.py is the
-    standalone, load-tunable twin."""
+    users" metric next to the training slope — plus the PAGED-KV
+    shared-prefix leg (`serving.paged` in the BENCH payload): the same
+    engine re-run on a trace where every prompt opens with one system
+    prompt, reporting prefix_hit_rate, cow_copies, and
+    slots_at_fixed_hbm (contiguous KV rows ÷ the pool's peak working
+    set — the vLLM capacity-recovery metric; ISSUE 11's bar is >= 2x).
+    Completions are asserted bit-identical across layouts. The decode
+    executables are warmed by one throwaway request so each measured
+    drain is steady-state continuous batching. scripts/serve_bench.py is
+    the standalone, load-tunable twin."""
     import numpy as np
 
     from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
@@ -529,11 +533,15 @@ def _serving_legs(cfg, on_tpu: bool) -> dict:
 
     if on_tpu:
         n_requests, slots, prompt_len, max_new = 32, 8, 8, 16
+        shared_prefix, block = 64, 16
+        sp_prompt_len = 96
     else:
         cfg = TransformerLMConfig(
             vocab_size=256, hidden_size=64, num_heads=2, num_layers=1,
             sequence_length=64, attention_impl="xla")
         n_requests, slots, prompt_len, max_new = 8, 4, 8, 8
+        shared_prefix, block = 9, 4
+        sp_prompt_len = 12
     config = FFConfig()
     config.batch_size = slots
     if on_tpu:
@@ -545,20 +553,25 @@ def _serving_legs(cfg, on_tpu: bool) -> dict:
     with telemetry.span("bench.serve.compile"):
         ff.compile(optimizer=SGDOptimizer(lr=0.01),
                    loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
-        engine = ff.serve(slots=slots, max_new_tokens=max_new,
-                          prefill_chunk=8)
+
+    def drain(engine, prompts, tag):
+        with telemetry.span("bench.serve.warmup", leg=tag):
+            engine.generate(prompts[:1])  # compile buckets + decode step
+        engine.reset_stats()
+        for p in prompts:
+            engine.submit(p)
+        with telemetry.span("bench.serve.measure", leg=tag,
+                            requests=len(prompts)):
+            engine.run_until_drained()
+        return ([r.generated for r in engine.scheduler.completed],
+                engine.stats())
+
     rs = np.random.RandomState(0)
     prompts = [rs.randint(1, cfg.vocab_size, prompt_len).tolist()
                for _ in range(n_requests)]
-    with telemetry.span("bench.serve.warmup"):
-        engine.generate(prompts[:1])  # compile buckets + decode step
-    engine.reset_stats()
-    for p in prompts:
-        engine.submit(p)
-    with telemetry.span("bench.serve.measure", requests=n_requests):
-        engine.run_until_drained()
-    stats = engine.stats()
-    return {
+    engine = ff.serve(slots=slots, max_new_tokens=max_new, prefill_chunk=8)
+    _, stats = drain(engine, prompts, "uniform")
+    out = {
         "requests_per_sec_per_chip": round(
             stats.get("requests_per_sec_per_chip", 0.0), 4),
         "decode_tokens_per_sec_per_chip": round(
@@ -566,8 +579,44 @@ def _serving_legs(cfg, on_tpu: bool) -> dict:
         "requests": stats["requests_completed"],
         "slots": slots,
         "max_new_tokens": max_new,
+        "kv_layout": stats["kv_layout"],
         "ttft_p50_s": round(stats.get("ttft_p50_s", 0.0), 4),
     }
+
+    # paged shared-prefix leg vs the contiguous ablation on one trace
+    system = rs.randint(1, cfg.vocab_size, shared_prefix).tolist()
+    tail = max(1, sp_prompt_len - shared_prefix)
+    sp = [system + rs.randint(1, cfg.vocab_size, tail).tolist()
+          if i else list(system) for i in range(n_requests)]
+    paged_eng = ff.serve(slots=slots, max_new_tokens=max_new,
+                         prefill_chunk=8, kv_layout="paged",
+                         kv_block_size=block)
+    paged_out, pst = drain(paged_eng, sp, "shared-prefix-paged")
+    contig_eng = ff.serve(slots=slots, max_new_tokens=max_new,
+                          prefill_chunk=8, kv_layout="contiguous")
+    contig_out, cst = drain(contig_eng, sp, "shared-prefix-contiguous")
+    if paged_out != contig_out:
+        raise AssertionError(
+            "paged completions diverge from contiguous on the "
+            "shared-prefix trace")
+    out["paged"] = {
+        "shared_prefix": shared_prefix,
+        "kv_block_size": pst["kv_block_size"],
+        "requests_per_sec_per_chip": round(
+            pst.get("requests_per_sec_per_chip", 0.0), 4),
+        "contiguous_requests_per_sec_per_chip": round(
+            cst.get("requests_per_sec_per_chip", 0.0), 4),
+        "prefix_hit_rate": round(pst.get("prefix_hit_rate", 0.0), 4),
+        "cow_copies": pst.get("cow_copies", 0),
+        "kv_blocks_in_use_peak": pst.get("kv_blocks_in_use_peak", 0),
+        "kv_hbm_bytes_per_layer": pst.get("kv_hbm_bytes_per_layer", 0),
+        "contiguous_kv_hbm_bytes_per_layer": cst.get(
+            "kv_hbm_bytes_per_layer", 0),
+        # the engine's one definition of the capacity-recovery ratio
+        # (serving/engine.py stats() `kv_peak_vs_contiguous`)
+        "slots_at_fixed_hbm": round(pst["kv_peak_vs_contiguous"], 4),
+    }
+    return out
 
 
 def main():
@@ -717,6 +766,13 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
             "value": serving["decode_tokens_per_sec_per_chip"],
             "unit": "tokens/s",
         }))
+        if "paged" in serving:
+            print(json.dumps({
+                "metric": "serving_paged_slots_at_fixed_hbm",
+                "value": serving["paged"]["slots_at_fixed_hbm"],
+                "prefix_hit_rate": serving["paged"]["prefix_hit_rate"],
+                "unit": "x contiguous",
+            }))
     except Exception as e:  # pragma: no cover - defensive
         print(f"bench: serving leg failed: {e}", file=sys.stderr)
 

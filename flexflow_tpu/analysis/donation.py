@@ -41,6 +41,7 @@ BUILDER_CALLEES = {
     "build_chunked_train_step": ("chunk_fn",),
     "build_eval_step": ("eval_fn", "_eval_step"),
     "build_decode_step": ("_step_fn", "_decode_step"),
+    "build_block_copy": ("_copy_fn",),
 }
 
 

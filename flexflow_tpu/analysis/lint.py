@@ -99,6 +99,7 @@ DONATED_CALLEES = {
     "_eval_step": (2,),
     "_step_fn": (1,),                 # build_decode_step (KV-cache state)
     "_decode_step": (1,),
+    "_copy_fn": (0,),                 # build_block_copy (paged KV pools)
 }
 
 _HASH_FN_HINTS = ("fingerprint", "signature", "digest", "_sha", "hash")

@@ -60,6 +60,8 @@ F32_INTERNAL = {
         "ops/attention.py preferred_element_type=float32",
     OT.OP_INC_MULTIHEAD_ATTENTION:
         "ops/inc_attention.py preferred_element_type=float32",
+    OT.OP_PAGED_INC_MULTIHEAD_ATTENTION:
+        "ops/inc_attention.py (paged) preferred_element_type=float32",
 }
 
 # reduce ops that SUM (max/min/argmax are order statistics — no

@@ -173,6 +173,15 @@ class FFConfig:
     serve_slots: int = 4
     serve_max_seq_len: int = 0
     serve_prefill_chunk: int = 16
+    # KV-cache layout: "paged" (block pool + per-slot page tables with
+    # copy-on-write prefix sharing, serving/paged.py — the default) or
+    # "contiguous" ((slots, max_seq+1, embed) per slot — the ablation/
+    # fallback). Block size is pool rows per block; blocks=0 sizes the
+    # pool from the per-chip HBM budget, capped at capacity parity.
+    # The layout is part of the warm-start plan fingerprint.
+    serve_kv_layout: str = "paged"
+    serve_kv_block_size: int = 16
+    serve_kv_blocks: int = 0
     # static plan verification (analysis/): the ffcheck pass pipeline —
     # sharding dataflow, memory liveness, collective uniformity,
     # donation/aliasing — runs at compile on EVERY plan source; errors
@@ -409,6 +418,17 @@ class FFConfig:
                 self.serve_max_seq_len = int(val())
             elif a == "--serve-prefill-chunk":
                 self.serve_prefill_chunk = int(val())
+            elif a == "--serve-kv-layout":
+                v = val()
+                if v not in ("contiguous", "paged"):
+                    raise ValueError(
+                        f"--serve-kv-layout must be 'contiguous' or "
+                        f"'paged', got {v!r}")
+                self.serve_kv_layout = v
+            elif a == "--serve-kv-block-size":
+                self.serve_kv_block_size = int(val())
+            elif a == "--serve-kv-blocks":
+                self.serve_kv_blocks = int(val())
             elif a == "--synthetic-input":
                 self.synthetic_input = True
             elif a == "--allow-tensor-op-math-conversion":

@@ -624,6 +624,32 @@ class Executor:
             decode_step, donate_argnums=_donate_argnums((1,)))
         return self._decode_step
 
+    def build_block_copy(self):
+        """Copy-on-write support for the paged KV layout: duplicate pool
+        blocks src[i] → dst[i] across EVERY layer's pool_k/pool_v in one
+        donated dispatch (the block ids are layer-uniform, so one (src,
+        dst) vector serves the whole stack). The serving engine pads the
+        vectors to a power-of-two width with (scratch → scratch) no-op
+        pairs, so the executable set stays O(log slots·chunk) like the
+        prefill buckets. Donating `state` updates the pools in place on
+        backends with donation — a COW costs one block-sized DMA per
+        layer, never a pool-sized allocation."""
+
+        def copy_blocks(state, src, dst):
+            new_state = {}
+            for name, ws in state.items():
+                nw = dict(ws)
+                for pool in ("pool_k", "pool_v"):
+                    buf = nw.get(pool)
+                    if buf is not None:
+                        nw[pool] = buf.at[dst].set(buf[src])
+                new_state[name] = nw
+            return new_state
+
+        self._copy_fn = jax.jit(
+            copy_blocks, donate_argnums=_donate_argnums((0,)))
+        return self._copy_fn
+
     def build_forward(self):
         def forward(params, state, x_inputs, training):
             logits, new_state, _ = self._apply(

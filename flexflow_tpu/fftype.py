@@ -200,6 +200,10 @@ class OperatorType(enum.IntEnum):
     # the serving-engine op the reference snapshot predates (its later
     # serving rewrite added IncMultiHeadSelfAttention; PAPER.md §0)
     OP_INC_MULTIHEAD_ATTENTION = enum.auto()
+    # paged variant: the KV cache is a shared block pool + per-slot page
+    # tables (vLLM/PagedAttention, SOSP '23) instead of a contiguous
+    # per-slot region — the serving memory lever (docs/serving.md)
+    OP_PAGED_INC_MULTIHEAD_ATTENTION = enum.auto()
     OP_FUSED = enum.auto()
     OP_RSQRT = enum.auto()
     OP_POW = enum.auto()

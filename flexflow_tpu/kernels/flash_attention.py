@@ -1423,6 +1423,181 @@ def flash_decode_attention(
     return out
 
 
+# ------------------------------------------------------- paged decode
+# Serving's paged hot path (vLLM/PagedAttention): the KV cache is a block
+# pool (num_blocks, block_size, H·hd) shared by every slot, and each slot
+# reads its cache THROUGH a page table (slots, blocks_per_slot) int32. The
+# kernel is the single-query decode kernel with the kv grid axis walking
+# the page table instead of a contiguous cache: the K/V BlockSpec index
+# maps read the physical block id from the scalar-prefetched table
+# (PrefetchScalarGridSpec), so the gather costs nothing beyond the DMA the
+# contiguous kernel already issues — and the dead-block skip is preserved
+# (logical blocks past the slot's cursor are never fetched; their table
+# entries point at the scratch block and the `pl.when` guard skips them).
+
+
+def _paged_decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         *refs, scale: float, block_size: int, nj: int):
+    if nj == 1:
+        m_ref = l_ref = acc_ref = None
+    else:
+        m_ref, l_ref, acc_ref = refs
+    j = pl.program_id(2)
+    s = pl.program_id(0)
+    length = len_ref[s]
+
+    @pl.when(j == 0)
+    def _init():
+        if nj > 1:
+            m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def step():
+        q = q_ref[0]  # (1, d)
+        k = k_ref[0]  # (block_size, d) — physical block tbl[s, j]
+        v = v_ref[0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (1, block_size)
+        # LOGICAL key position of row r in this block is j*block_size + r
+        # (the table maps logical→physical; the logical axis is what the
+        # per-slot length masks)
+        key_pos = jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1) + j * block_size
+        logits = jnp.where(key_pos < length, logits, NEG_INF)
+        # zero masked V rows: rows past the cursor in a partially-filled
+        # block hold stale pool state (NaN in interpret mode)
+        v = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+            + j * block_size < length, v, 0.0)
+        if nj == 1:
+            m = logits.max(axis=-1)
+            p = jnp.exp(logits - m[:, None])
+            l = p.sum(axis=-1)
+            acc = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(
+                o_ref.dtype)
+        else:
+            m_prev = m_ref[...]
+            m_new = jnp.maximum(m_prev, logits.max(axis=-1))
+            p = jnp.exp(logits - m_new[:, None])
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1)
+            acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[...] = m_new
+
+    if nj == 1:
+        step()
+        return
+    # dead-block skip: a logical block entirely past the cursor is never
+    # computed (its physical block — usually scratch — may still DMA; the
+    # table keeps unallocated entries at scratch so that DMA is one hot
+    # block, not a cold pool walk)
+    pl.when(j * block_size < length)(step)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...]
+                    / jnp.maximum(l_ref[...], 1e-30)[:, None]).astype(
+                        o_ref.dtype)
+
+
+def paged_decode_attention_reference(q, pool_k, pool_v, page_table,
+                                     positions, *, num_heads: int,
+                                     scale: float | None = None):
+    """Einsum oracle for the paged decode kernel (and the CPU serving
+    path, via ops/inc_attention.py): gather each slot's logical cache
+    view from the pool through its page table, then run the contiguous
+    reference. q: (slots, q_len, H·hd); pool_k/v: (num_blocks, bs, H·hd);
+    page_table: (slots, W) int32; positions: (slots, q_len) int32 (query
+    row i attends logical rows [0, positions[s, i]]; negative = dead)."""
+    slots = q.shape[0]
+    W = page_table.shape[1]
+    bs = pool_k.shape[1]
+    e = pool_k.shape[-1]
+    kc = pool_k[page_table].reshape(slots, W * bs, e).astype(q.dtype)
+    vc = pool_v[page_table].reshape(slots, W * bs, e).astype(q.dtype)
+    return decode_attention_reference(q, kc, vc, positions,
+                                      num_heads=num_heads, scale=scale)
+
+
+def paged_flash_decode_attention(
+    q, pool_k, pool_v, page_table, lengths, *, num_heads: int,
+    scale: float | None = None, interpret: bool | None = None,
+):
+    """Single-query decode attention over a paged KV pool. q: (slots, 1,
+    H·hd); pool_k/v: (num_blocks, block_size, H·hd); page_table: (slots,
+    W) int32 logical→physical block map; lengths: (slots,) int32 live-key
+    counts. The kv grid walks the page table via scalar prefetch — one
+    (1, block_size, head) K/V block DMA per live logical block, dead
+    blocks skipped. Shapes the kernel can't tile on hardware fall back to
+    the gather + einsum reference (the CPU serving path routes there
+    directly)."""
+    slots, q_len, e = q.shape
+    if q_len != 1:
+        raise ValueError(f"decode kernel is single-query (got q_len={q_len})")
+    bs = pool_k.shape[1]
+    W = page_table.shape[1]
+    d = e // num_heads
+    if e % num_heads != 0:
+        raise ValueError(f"embed dim {e} % heads {num_heads} != 0")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # Mosaic gates (see flash_decode_attention) + the paged-specific one:
+    # a block must be a legal (sublane, lane) tile, so tiny block sizes
+    # route to the reference
+    lane_ok = d % 128 == 0 or num_heads == 1 or interpret
+    if W * bs < 128 or bs % 8 != 0 or not lane_ok:
+        positions = (lengths.astype(jnp.int32) - 1)[:, None]
+        return paged_decode_attention_reference(
+            q, pool_k, pool_v, page_table, positions,
+            num_heads=num_heads, scale=scale)
+    nj = W
+    lengths = lengths.astype(jnp.int32)
+    table = page_table.astype(jnp.int32)
+    qspec = pl.BlockSpec((1, 1, d), lambda s, h, j, tbl, ln: (s, 0, h))
+    # the paged gather: the physical block row comes from the prefetched
+    # table, not the grid index
+    kspec = pl.BlockSpec(
+        (1, bs, d), lambda s, h, j, tbl, ln: (tbl[s, j], 0, h))
+    scratch_shapes = []
+    if nj > 1:
+        scratch_shapes = [
+            pltpu.VMEM((1,), jnp.float32),
+            pltpu.VMEM((1,), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, num_heads, nj),
+        in_specs=[qspec, kspec, kspec],
+        out_specs=qspec,
+        scratch_shapes=scratch_shapes,
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale,
+                          block_size=bs, nj=nj),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, 1, e), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+        name="flash_attention_paged_decode",
+    )(table, lengths, q, pool_k, pool_v)
+    return out
+
+
 def flash_attention(
     q, k, v, *, causal: bool = False, scale: float | None = None,
     block_q: int = 512, block_k: int = 512,

@@ -454,6 +454,37 @@ class FFModel:
                                [input, positions], name,
                                data_type=input.dtype).outputs[0]
 
+    def paged_inc_multihead_attention(
+        self,
+        input: Tensor,
+        positions: Tensor,
+        page_table: Tensor,
+        embed_dim: int,
+        num_heads: int,
+        max_seq_len: int,
+        block_size: int,
+        num_blocks: int,
+        use_bias: bool = True,
+        impl: str = "auto",
+        name: str = "",
+    ) -> Tensor:
+        """Decode-phase self-attention over a PAGED KV cache (serving/,
+        vLLM-style): per-layer block pools `pool_k`/`pool_v` of shape
+        (num_blocks, block_size, embed_dim) — block 0 reserved as scratch
+        — addressed through the shared `page_table` input ((slots,
+        ceil(max_seq_len/block_size)) int32, logical→physical). Pools are
+        non-trainable stateful weights placed by the plan; weight names
+        match multihead_attention's, so trained parameters transfer by
+        name exactly like the contiguous decode op's."""
+        from .ops import PagedIncMultiHeadAttentionParams
+
+        p = PagedIncMultiHeadAttentionParams(
+            embed_dim, num_heads, max_seq_len, block_size, num_blocks,
+            use_bias, impl)
+        return self._add_layer(OT.OP_PAGED_INC_MULTIHEAD_ATTENTION, p,
+                               [input, positions, page_table], name,
+                               data_type=input.dtype).outputs[0]
+
     def concat(self, tensors: Sequence[Tensor], axis: int, name: str = "") -> Tensor:
         p = ConcatParams(axis, len(tensors))
         return self._add_layer(OT.OP_CONCAT, p, list(tensors), name,
@@ -1284,6 +1315,10 @@ class FFModel:
                 # multiply per-chip HBM by the data degree. A searched/
                 # imported plan (e.g. head-parallel attention also sharding
                 # the cache feature dim over `model`) overrides below.
+                # (The PAGED op takes no such default on purpose: its
+                # pool's leading dim is physical blocks shared across
+                # slots by prefix reuse, so it stays whole on the batch
+                # axes — only a head-parallel plan shards its feature dim.)
                 for ws in node.weight_specs:
                     if not ws.trainable and ws.shape[0] % batch_deg == 0:
                         node.weight_axes.setdefault(
@@ -1998,9 +2033,12 @@ class FFModel:
         becomes incremental attention over sharded KV-cache state, priced
         and placed by the same Unity search + warm-start plan cache the
         trainer uses), adopts this model's weights by name, and runs
-        Orca-style continuous batching over a fixed slot set
-        (docs/serving.md). kwargs override ServingSpec fields — slots,
-        max_seq_len, prefill_chunk, config_overrides, strategy, ..."""
+        Orca-style continuous batching over a fixed slot set, with a
+        paged block-pool KV cache (COW prefix sharing, chunked prefill
+        interleaved with decode) by default (docs/serving.md). kwargs
+        override ServingSpec fields — slots, max_seq_len, prefill_chunk,
+        kv_layout ("paged"|"contiguous"), kv_block_size, kv_num_blocks,
+        prefix_sharing, config_overrides, strategy, ..."""
         assert self._compiled, "call compile() before serve()"
         from .serving import ServingEngine
 

@@ -125,31 +125,54 @@ def build_transformer_lm(ff, config: TransformerLMConfig | None = None,
 def build_transformer_lm_decode(ff, config: TransformerLMConfig | None = None,
                                 slots: int | None = None,
                                 max_seq_len: int | None = None,
-                                impl: str = "auto"):
+                                impl: str = "auto",
+                                kv_layout: str | None = None,
+                                kv_block_size: int | None = None,
+                                kv_num_blocks: int = 0):
     """The flagship LM's *decode* graph, built directly (the model-zoo
     twin of serving/decode_graph's generic replay): single-token query per
     continuous-batching slot, per-layer KV caches written at the
     position-indexed rows the `positions` input names. Same `_lm_trunk`,
     same layer names — a model trained with `build_transformer_lm` feeds
-    this graph its weights unchanged. Returns (tokens, positions, logits);
-    compile with CompMode.COMP_MODE_INFERENCE."""
+    this graph its weights unchanged. `kv_layout` mirrors the serving
+    engine's (default: the config's --serve-kv-layout): "paged" adds the
+    shared `page_table` input and block-pool caches, "contiguous" the
+    per-slot region. Returns (tokens, positions, logits); compile with
+    CompMode.COMP_MODE_INFERENCE."""
     c = config or TransformerLMConfig()
     n = slots or ff.config.serve_slots
     max_seq = max_seq_len or c.sequence_length
+    layout = kv_layout or ff.config.serve_kv_layout
     tokens = ff.create_tensor((n, 1), DataType.DT_INT32, create_grad=False,
                               name="tokens")
-    h = ff.embedding(tokens, c.vocab_size, c.hidden_size, name="wte")
     pos = ff.create_tensor((n, 1), DataType.DT_INT32, create_grad=False,
                            name="positions")
+    if layout == "paged":
+        bs = kv_block_size or ff.config.serve_kv_block_size
+        table_width = -(-max_seq // bs)
+        # capacity parity + the reserved scratch block — the same default
+        # serving/decode_graph.resolve_pool_blocks lands on when the HBM
+        # budget doesn't bind
+        num_blocks = kv_num_blocks or n * table_width + 1
+        page_table = ff.create_tensor(
+            (n, table_width), DataType.DT_INT32, create_grad=False,
+            name="page_table")
+
+        def attention(a, name):
+            return ff.paged_inc_multihead_attention(
+                a, pos, page_table, c.hidden_size, c.num_heads, max_seq,
+                bs, num_blocks, impl=impl, name=name,
+            )
+    else:
+        def attention(a, name):
+            return ff.inc_multihead_attention(
+                a, pos, c.hidden_size, c.num_heads, max_seq, impl=impl,
+                name=name,
+            )
+
+    h = ff.embedding(tokens, c.vocab_size, c.hidden_size, name="wte")
     hp = ff.embedding(pos, c.sequence_length, c.hidden_size, name="wpe")
     h = ff.add(h, hp, name="embed_add")
-
-    def attention(a, name):
-        return ff.inc_multihead_attention(
-            a, pos, c.hidden_size, c.num_heads, max_seq, impl=impl,
-            name=name,
-        )
-
     logits = _lm_trunk(ff, c, h, attention)
     return tokens, pos, logits
 

@@ -21,7 +21,10 @@ from .core import (
     SoftmaxParams,
 )
 from .attention import MultiHeadAttentionParams
-from .inc_attention import IncMultiHeadAttentionParams
+from .inc_attention import (
+    IncMultiHeadAttentionParams,
+    PagedIncMultiHeadAttentionParams,
+)
 from .elementwise import ElementBinaryParams, ElementUnaryParams
 from .moe import (
     AggregateParams,

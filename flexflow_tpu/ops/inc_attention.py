@@ -153,3 +153,159 @@ def _inc_mha_flops(p: IncMultiHeadAttentionParams, in_shapes, out_shapes):
 
 register_op(OpDef(OT.OP_INC_MULTIHEAD_ATTENTION, _inc_mha_infer,
                   _inc_mha_forward, _inc_mha_weights, _inc_mha_flops))
+
+
+# ===================================================================== paged
+# Paged variant (vLLM/PagedAttention, SOSP '23): the per-layer KV cache is a
+# shared BLOCK POOL `pool_k`/`pool_v` of shape (num_blocks, block_size,
+# embed) plus a per-slot PAGE TABLE input (slots, blocks_per_slot) int32
+# mapping logical block j of a slot to a physical pool block. The pool is
+# still a first-class stateful parallel tensor (non-trainable weight spec):
+# Unity places and prices it — the feature dim shards over `model` under a
+# head-parallel plan exactly like the contiguous cache — and it is donated
+# through the decode step like any state.
+#
+# Physical block 0 is the RESERVED SCRATCH BLOCK, the paged equivalent of
+# the contiguous layout's scratch row `max_seq_len`: an element whose
+# position clips out of [0, max_seq_len) writes ZEROS into block 0, so
+# padded/empty elements never disturb a live block and the pool only ever
+# holds finite values (same NaN-poisoning guard as the contiguous write).
+# The block-sharing invariant is host-side: the engine's BlockManager
+# guarantees (COW) that a physical block referenced by more than one page
+# table is never the target of a write — the device op writes wherever the
+# table points.
+
+
+@dataclass(frozen=True)
+class PagedIncMultiHeadAttentionParams:
+    embed_dim: int
+    num_heads: int
+    max_seq_len: int    # logical cache rows per slot (capacity)
+    block_size: int     # pool rows per block
+    num_blocks: int     # physical pool blocks, block 0 = reserved scratch
+    use_bias: bool = True
+    impl: str = "auto"  # auto: paged flash decode on TPU (q_len=1)
+
+    @property
+    def blocks_per_slot(self) -> int:
+        """Page-table width: logical blocks covering max_seq_len rows."""
+        return -(-self.max_seq_len // self.block_size)
+
+
+def _paged_mha_infer(p: PagedIncMultiHeadAttentionParams, in_shapes):
+    x, positions, page_table = in_shapes
+    if page_table[-1] != p.blocks_per_slot:
+        raise ValueError(
+            f"page_table width {page_table[-1]} != blocks_per_slot "
+            f"{p.blocks_per_slot} (= ceil({p.max_seq_len}/{p.block_size}))")
+    return [(x[0], x[1], p.embed_dim)]
+
+
+def _paged_mha_weights(p: PagedIncMultiHeadAttentionParams, in_shapes):
+    x = in_shapes[0]
+    ws = [
+        WeightSpec("wq", (x[-1], p.embed_dim), DataType.DT_FLOAT),
+        WeightSpec("wk", (x[-1], p.embed_dim), DataType.DT_FLOAT),
+        WeightSpec("wv", (x[-1], p.embed_dim), DataType.DT_FLOAT),
+        WeightSpec("wo", (p.embed_dim, p.embed_dim), DataType.DT_FLOAT),
+    ]
+    if p.use_bias:
+        ws += [
+            WeightSpec("bq", (p.embed_dim,), DataType.DT_FLOAT, "zeros"),
+            WeightSpec("bk", (p.embed_dim,), DataType.DT_FLOAT, "zeros"),
+            WeightSpec("bv", (p.embed_dim,), DataType.DT_FLOAT, "zeros"),
+            WeightSpec("bo", (p.embed_dim,), DataType.DT_FLOAT, "zeros"),
+        ]
+    # the block pool: ONE tensor per layer shared by every slot (a block
+    # mapped into N page tables is stored once — the prefix-sharing win),
+    # so per-chip accounting counts it once, not per slot
+    ws += [
+        WeightSpec("pool_k", (p.num_blocks, p.block_size, p.embed_dim),
+                   DataType.DT_FLOAT, "zeros", trainable=False),
+        WeightSpec("pool_v", (p.num_blocks, p.block_size, p.embed_dim),
+                   DataType.DT_FLOAT, "zeros", trainable=False),
+    ]
+    return ws
+
+
+def _paged_mha_forward(p: PagedIncMultiHeadAttentionParams, inputs, weights,
+                       state, ctx):
+    x, positions, page_table = inputs
+    slots, q_len, _ = x.shape
+    H, E = p.num_heads, p.embed_dim
+    hd = E // H
+    bs = p.block_size
+    W = p.blocks_per_slot
+
+    def proj(t, w, b):
+        tm, wm = matmul_cast(ctx, t, w.astype(t.dtype))
+        y = jnp.dot(tm, wm, preferred_element_type=jnp.float32).astype(t.dtype)
+        if b is not None:
+            y = y + b.astype(y.dtype)
+        return y
+
+    q = proj(x, weights["wq"], weights.get("bq"))
+    k = proj(x, weights["wk"], weights.get("bk"))
+    v = proj(x, weights["wv"], weights.get("bv"))
+    scale = 1.0 / math.sqrt(hd)
+
+    pk, pv = weights["pool_k"], weights["pool_v"]
+    positions = positions.astype(jnp.int32)
+    page_table = page_table.astype(jnp.int32)
+    live = (positions >= 0) & (positions < p.max_seq_len)
+    # position → (physical block, in-block offset) through the page table;
+    # dead elements route to the scratch block (0) and write zeros — see
+    # the contiguous op's scratch-row rationale (NaN'd pad hidden states
+    # must never reach the pool even though reads mask them)
+    pos_c = jnp.clip(positions, 0, p.max_seq_len - 1)
+    logical = pos_c // bs                       # (slots, q_len) in [0, W)
+    offset = pos_c % bs
+    phys = jnp.take_along_axis(page_table, logical, axis=1)
+    phys = jnp.where(live, phys, 0)
+    kw = jnp.where(live[..., None], k, 0.0)
+    vw = jnp.where(live[..., None], v, 0.0)
+    pk = pk.at[phys, offset].set(kw.astype(pk.dtype))
+    pv = pv.at[phys, offset].set(vw.astype(pv.dtype))
+
+    use_flash = (p.impl == "flash"
+                 or (p.impl == "auto" and jax.default_backend() == "tpu"))
+    if use_flash and q_len == 1:
+        from ..kernels.flash_attention import paged_flash_decode_attention
+
+        out = paged_flash_decode_attention(
+            q, pk.astype(q.dtype), pv.astype(q.dtype), page_table,
+            jnp.where(live[:, 0], pos_c[:, 0] + 1, 0),
+            num_heads=H, scale=scale)
+    else:
+        # reference path (CPU tier-1 + the kernel's numerics oracle):
+        # gather each slot's logical cache view from the pool, then run
+        # the SAME masked einsum as the contiguous op — token identity
+        # between the layouts reduces to the gather being the identity
+        # on live rows
+        kc = pk[page_table].reshape(slots, W * bs, E).astype(q.dtype)
+        vc = pv[page_table].reshape(slots, W * bs, E).astype(q.dtype)
+        from ..kernels.flash_attention import decode_attention_reference
+
+        read_pos = jnp.where(live, pos_c, -1)
+        out = decode_attention_reference(
+            q, kc, vc, read_pos, num_heads=H, scale=scale)
+    y = proj(out, weights["wo"], weights.get("bo"))
+    return [y], {"pool_k": pk, "pool_v": pv}
+
+
+def _paged_mha_flops(p: PagedIncMultiHeadAttentionParams, in_shapes,
+                     out_shapes):
+    x = in_shapes[0]
+    slots, q_len = x[0], x[1]
+    E = p.embed_dim
+    # same shape as the contiguous op's count: projections of the new
+    # tokens + worst-case full-capacity cache read per query (the kernel
+    # skips dead blocks at run time; the pricer keeps the upper bound)
+    proj = 2.0 * slots * q_len * (3 * x[-1] * E + E * E)
+    attn = 2.0 * slots * p.num_heads * q_len * (
+        p.blocks_per_slot * p.block_size) * (E // p.num_heads) * 2
+    return proj + attn
+
+
+register_op(OpDef(OT.OP_PAGED_INC_MULTIHEAD_ATTENTION, _paged_mha_infer,
+                  _paged_mha_forward, _paged_mha_weights, _paged_mha_flops))

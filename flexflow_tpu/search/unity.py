@@ -138,6 +138,11 @@ class UnitySearch:
                 (w.name, PartitionSpec(self._batch_entry(),
                                        *([None] * (len(w.shape) - 1))))
                 for w in node.weight_specs if not w.trainable))
+        # (the PAGED op's pool deliberately gets NO slot-dim entry: its
+        # leading dim is physical blocks shared across slots — prefix
+        # sharing means any slot may read any block, so the pool stays
+        # whole on the batch axes and the dp price correctly charges the
+        # full pool per chip; the feature dim is the searched dim below)
         out = [dp]
         if node.op_type == OT.OP_PIPE_BLOCKS:
             from ..machine import AXIS_PIPE
@@ -225,7 +230,8 @@ class UnitySearch:
                                          batch_axes=self.batch_axes))
                 assign[1] = (AXIS_SEQ,)
                 out.append(NodeConfig("sp", tuple(assign)))
-        elif node.op_type == OT.OP_INC_MULTIHEAD_ATTENTION:
+        elif node.op_type in (OT.OP_INC_MULTIHEAD_ATTENTION,
+                              OT.OP_PAGED_INC_MULTIHEAD_ATTENTION):
             p = node.params
             if (allow_attr and p.num_heads % self.model_deg == 0
                     and p.embed_dim % self.model_deg == 0):
@@ -235,6 +241,11 @@ class UnitySearch:
                 # chip stores and scans only its own heads' cache rows.
                 # The KV-cache placement is thereby a searched parallel
                 # dim priced by the same cost model as the projections.
+                # Contiguous caches additionally ride the batch axes on
+                # their slot dim; the paged POOL's leading dim is
+                # slot-agnostic physical blocks (shared by prefix reuse),
+                # so only its feature dim shards.
+                paged = node.op_type == OT.OP_PAGED_INC_MULTIHEAD_ATTENTION
                 ws = [(w, PartitionSpec(None, AXIS_MODEL))
                       for w in ("wq", "wk", "wv")]
                 ws += [(b, PartitionSpec(AXIS_MODEL))
@@ -242,7 +253,8 @@ class UnitySearch:
                 ws += [("wo", PartitionSpec(AXIS_MODEL, None)),
                        ("bo", PartitionSpec())]
                 ws += [(w.name, PartitionSpec(
-                            self._batch_entry() if batch_ok else None,
+                            None if paged else
+                            (self._batch_entry() if batch_ok else None),
                             None, AXIS_MODEL))
                        for w in node.weight_specs if not w.trainable]
                 out.append(NodeConfig(

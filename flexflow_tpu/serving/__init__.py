@@ -6,7 +6,11 @@ attention becomes incremental attention over first-class sharded KV-cache
 state, placed and priced by the same Unity search and warm-started by the
 same plan cache — and runs Orca-style continuous batching over a fixed
 slot set with greedy/temperature sampling, EOS/max-length completion, and
-per-request time-to-first-token telemetry.
+per-request time-to-first-token telemetry. The default KV layout is a
+PAGED block pool + per-slot page tables with copy-on-write prefix sharing
+(paged.BlockManager; `--serve-kv-layout contiguous` is the bit-identical
+ablation), and prefill proceeds one bucketed chunk per iteration,
+interleaved with the in-flight decodes.
 
     engine = model.serve(slots=8, max_new_tokens=64)
     outputs = engine.generate(prompts)          # batch convenience
@@ -15,9 +19,11 @@ per-request time-to-first-token telemetry.
 
 from .decode_graph import ServingSpec, adopt_params, build_decode_model
 from .engine import ServingEngine
+from .paged import BlockManager, CopyPlan, PagedStats
 from .scheduler import ContinuousBatchingScheduler, Request, Slot
 
 __all__ = [
     "ServingEngine", "ServingSpec", "Request", "Slot",
     "ContinuousBatchingScheduler", "build_decode_model", "adopt_params",
+    "BlockManager", "CopyPlan", "PagedStats",
 ]

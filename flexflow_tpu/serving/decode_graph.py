@@ -38,6 +38,16 @@ class ServingSpec:
     max_new_tokens: int = 32  # per-request default
     eos_id: Optional[int] = None  # per-request default (None = never)
     impl: str = "auto"  # decode attention impl (auto|xla|flash)
+    # KV-cache layout (--serve-kv-layout): "paged" = block pool + per-slot
+    # page tables with COW prefix sharing (the default);  "contiguous" =
+    # the (slots, max_seq+1, embed) per-slot region — the ablation/
+    # fallback layout (docs/serving.md)
+    kv_layout: str = "paged"
+    kv_block_size: int = 16  # pool rows per block (paged only)
+    # physical pool blocks incl. the reserved scratch block; 0 → sized
+    # from the per-chip HBM budget, capped at contiguous capacity parity
+    kv_num_blocks: int = 0
+    prefix_sharing: bool = True  # COW prompt-prefix reuse (paged only)
     # extra FFConfig fields applied to the decode compile only (e.g.
     # {"search_budget": 6, "enable_parameter_parallel": True})
     config_overrides: dict = field(default_factory=dict)
@@ -54,6 +64,11 @@ def _decode_config(model, spec: ServingSpec):
     and cached with the same machinery."""
     cfg = copy.copy(model.config)  # plain copy: __post_init__ re-parses argv
     cfg.batch_size = spec.slots
+    # the layout is part of the decode plan's identity: the warm-start
+    # fingerprint hashes serve_kv_layout (warmstart/fingerprint.py), so a
+    # contiguous and a paged plan can never share a cache address even
+    # before the structural graph difference discriminates them
+    cfg.serve_kv_layout = spec.kv_layout
     cfg.telemetry_dir = ""
     cfg.xprof_dir = ""
     cfg.diagnostics = False
@@ -68,6 +83,48 @@ def _decode_config(model, spec: ServingSpec):
             raise ValueError(f"config_overrides: FFConfig has no field {k!r}")
         setattr(cfg, k, v)
     return cfg
+
+
+def resolve_pool_blocks(model, spec: ServingSpec, max_seq: int) -> int:
+    """Physical block count for the paged pool (incl. the reserved scratch
+    block 0). spec.kv_num_blocks > 0 pins it; 0 sizes the pool from the
+    per-chip HBM budget — the machine model's chip capacity minus the
+    decode graph's non-pool footprint (the trained weights that transfer
+    by name — the same number the ffcheck liveness pass charges as
+    persistent weight bytes) — capped at contiguous capacity parity
+    (every slot can reach max_seq), floored at one block per slot so the
+    engine can always make progress."""
+    bs = spec.kv_block_size
+    if bs < 1:
+        raise ValueError(f"kv_block_size must be >= 1, got {bs}")
+    table_width = -(-max_seq // bs)
+    if spec.kv_num_blocks:
+        if spec.kv_num_blocks < 2:
+            raise ValueError(
+                f"kv_num_blocks must be >= 2 (scratch + 1), got "
+                f"{spec.kv_num_blocks}")
+        return spec.kv_num_blocks
+    capacity = spec.slots * table_width + 1
+    try:
+        import numpy as np
+
+        from ..search.machine_model import machine_model_for_mesh
+
+        hbm = machine_model_for_mesh(model.mesh).chip.hbm_bytes
+        weight_bytes = sum(
+            np.asarray(w).size * np.asarray(w).dtype.itemsize
+            for ws in (model._params or {}).values() for w in ws.values())
+        attn = [l for l in model.layers
+                if l.op_type == OT.OP_MULTIHEAD_ATTENTION]
+        block_bytes = sum(2 * bs * l.params.embed_dim * 4 for l in attn)
+        if block_bytes <= 0:
+            return capacity
+        budget = 0.9 * hbm - weight_bytes
+        fit = int(budget // block_bytes)
+        return max(spec.slots + 1, min(capacity, fit))
+    except Exception:
+        # no machine model / no params yet: capacity parity is always safe
+        return capacity
 
 
 def infer_max_seq_len(model) -> int:
@@ -90,7 +147,13 @@ def build_decode_model(model, spec: ServingSpec):
     from ..model import FFModel
     from ..optimizer import SGDOptimizer
 
+    if spec.kv_layout not in ("contiguous", "paged"):
+        raise ValueError(
+            f"kv_layout must be 'contiguous' or 'paged', got "
+            f"{spec.kv_layout!r}")
     max_seq = spec.max_seq_len or infer_max_seq_len(model)
+    paged = spec.kv_layout == "paged"
+    num_blocks = resolve_pool_blocks(model, spec, max_seq) if paged else 0
     dec = FFModel(_decode_config(model, spec))
 
     # --- inputs: (batch, seq, ...) → (slots, 1, ...); the `positions`
@@ -112,6 +175,15 @@ def build_decode_model(model, spec: ServingSpec):
     if positions is None:
         positions = dec.create_tensor((spec.slots, 1), DataType.DT_INT32,
                                       create_grad=False, name="positions")
+    page_table = None
+    if paged:
+        # one page table feeds every attention layer: block ids index the
+        # same physical slot across all layers' pools (vLLM's layout), so
+        # the host manages ONE table per slot, not one per layer
+        table_width = -(-max_seq // spec.kv_block_size)
+        page_table = dec.create_tensor(
+            (spec.slots, table_width), DataType.DT_INT32,
+            create_grad=False, name="page_table")
 
     # --- layers, replayed name-for-name
     layer_map: dict[int, object] = {}  # train layer guid -> decode Layer
@@ -148,14 +220,26 @@ def build_decode_model(model, spec: ServingSpec):
                 raise ValueError(
                     f"{layer.name}: kdim/vdim != embed_dim not supported "
                     f"in the decode graph")
-            from ..ops import IncMultiHeadAttentionParams
+            if paged:
+                from ..ops import PagedIncMultiHeadAttentionParams
 
-            np_ = IncMultiHeadAttentionParams(
-                p.embed_dim, p.num_heads, max_seq, p.use_bias,
-                impl=spec.impl)
-            new = dec._add_layer(
-                OT.OP_INC_MULTIHEAD_ATTENTION, np_, [ins[0], positions],
-                name=layer.name, data_type=layer.data_type)
+                np_ = PagedIncMultiHeadAttentionParams(
+                    p.embed_dim, p.num_heads, max_seq,
+                    spec.kv_block_size, num_blocks, p.use_bias,
+                    impl=spec.impl)
+                new = dec._add_layer(
+                    OT.OP_PAGED_INC_MULTIHEAD_ATTENTION, np_,
+                    [ins[0], positions, page_table],
+                    name=layer.name, data_type=layer.data_type)
+            else:
+                from ..ops import IncMultiHeadAttentionParams
+
+                np_ = IncMultiHeadAttentionParams(
+                    p.embed_dim, p.num_heads, max_seq, p.use_bias,
+                    impl=spec.impl)
+                new = dec._add_layer(
+                    OT.OP_INC_MULTIHEAD_ATTENTION, np_, [ins[0], positions],
+                    name=layer.name, data_type=layer.data_type)
         else:
             new = dec._add_layer(
                 layer.op_type, layer.params, ins, name=layer.name,
@@ -195,7 +279,7 @@ def adopt_params(dec, model) -> int:
         src = (model._state or {}).get(
             model._resolve_weight_owner(node_name), {})
         for wname in ws:
-            if wname in ("cache_k", "cache_v"):
+            if wname in ("cache_k", "cache_v", "pool_k", "pool_v"):
                 continue
             if wname in src:
                 arr = np.asarray(src[wname])

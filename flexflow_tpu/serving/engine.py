@@ -1,24 +1,42 @@
 """ServingEngine: continuous-batching inference on the decode PCG.
 
-The device side of serving (scheduler.py is the policy side): one donated
-jitted step (`Executor.build_decode_step`) threads (params, kv-cache
-state, tokens, positions) and returns the next token per slot, sampled
-in-program (greedy / temperature-Gumbel per slot). Prefill reuses the
-pipelined engine's chunk planner (engine/chunking.plan_chunks) to walk a
-prompt through the SAME step in power-of-two length buckets — each bucket
-one cached executable — writing the prompt's K/V rows into the admitted
-slot's cache while every other slot's writes land on the scratch row
-(position redirection, ops/inc_attention.py), so a fixed-shape executable
-serves slots at arbitrary, different sequence positions.
+The device side of serving (scheduler.py is the policy side; paged.py the
+block-pool side): one donated jitted step (`Executor.build_decode_step`)
+threads (params, kv-cache state, tokens, positions[, page tables]) and
+returns the next token per slot, sampled in-program (greedy /
+temperature-Gumbel per slot).
+
+**Chunked prefill, interleaved with decode** (Orca's iteration-level
+scheduling at sub-request grain): every `step()` runs exactly ONE device
+call, carrying at most one prefill CHUNK (engine/chunking.plan_chunks
+buckets, power-of-two widths) in the admitted slot's rows while every
+DECODING slot advances one token in column 0 of the same call — long
+prompts therefore never stall the continuous batch, and a decoding slot's
+token stream is bit-identical either way because slot rows are computed
+independently (padding columns point at the scratch row/block).
+
+**KV layouts** (`--serve-kv-layout`, ServingSpec.kv_layout):
+  - "paged" (default): per-layer block POOLS (num_blocks, block_size,
+    embed) + per-slot page tables, with copy-on-write prompt-prefix
+    sharing managed host-side by paged.BlockManager — N requests with one
+    system prompt store (and prefill) it once. COW copies run through the
+    donated `Executor.build_block_copy` executable before the step that
+    writes.
+  - "contiguous": the (slots, max_seq+1, embed) per-slot cache — the
+    ablation/fallback layout.
+Both are first-class stateful parallel tensors placed by the Unity
+search; the two layouts are token-identical on the full test matrix.
 
 Invariants the tests pin down (tests/test_serving.py):
   - greedy decode is token-identical to the teacher-forced training
     forward's argmax at every position;
   - an interleaved continuous batch is token-identical to serving each
     request alone (slot rows are computed independently);
+  - paged decode is token-identical to contiguous decode, COW included;
   - the engine compile is a normal Unity compile: warm-start plan-cache
     hits apply (second serving compile of the same (model, slots,
-    max_seq, mesh) = 0 search evaluations).
+    max_seq, mesh, kv layout) = 0 search evaluations), and contiguous and
+    paged plans never share a cache address.
 
 Telemetry (when the trained model has a session): `serve.compile` /
 `serve.prefill` / `serve.step` spans, per-iteration queue-depth and
@@ -38,6 +56,7 @@ import numpy as np
 from .. import telemetry
 from ..engine.chunking import plan_chunks
 from .decode_graph import ServingSpec, adopt_params, build_decode_model
+from .paged import SCRATCH_BLOCK, BlockManager
 from .scheduler import ContinuousBatchingScheduler, Request
 
 
@@ -55,6 +74,9 @@ class ServingEngine:
             slots=cfg.serve_slots,
             max_seq_len=cfg.serve_max_seq_len,
             prefill_chunk=cfg.serve_prefill_chunk,
+            kv_layout=cfg.serve_kv_layout,
+            kv_block_size=cfg.serve_kv_block_size,
+            kv_num_blocks=cfg.serve_kv_blocks,
         )
         for k, v in overrides.items():
             if not hasattr(spec, k):
@@ -78,6 +100,7 @@ class ServingEngine:
                 duration_s=time.perf_counter() - t0,
                 slots=spec.slots, max_seq_len=self.max_seq_len,
                 prefill_chunk=spec.prefill_chunk,
+                kv_layout=spec.kv_layout,
                 plan_source=self.decode_model._plan_source,
                 weights_adopted=self.adopted,
                 mesh_axes={k: int(v) for k, v
@@ -88,12 +111,29 @@ class ServingEngine:
             spec.slots, self.max_seq_len)
         self.num_chips = int(self.decode_model.mesh.devices.size)
         self._rng = None  # lazily split jax PRNG for sampling steps
-        # graph input roles: exactly one token stream + the positions feed
-        # (+ constants, which the engine materializes itself)
+        # paged layout: host-side block manager + the donated COW copy
+        # executable; pool geometry comes from the BUILT op (resolve_
+        # pool_blocks ran inside build_decode_model)
+        self.block_manager = None
+        self._copy_fn = None
+        if spec.kv_layout == "paged":
+            from ..fftype import OperatorType as OT
+
+            attn = next(
+                n for n in self.decode_model.graph.topo_order()
+                if n.op_type == OT.OP_PAGED_INC_MULTIHEAD_ATTENTION)
+            p = attn.params
+            self.block_manager = BlockManager(
+                p.num_blocks, p.block_size, p.blocks_per_slot,
+                sharing=spec.prefix_sharing)
+            self._copy_fn = (
+                self.decode_model.executor.build_block_copy())
+        # graph input roles: exactly one token stream + the positions /
+        # page-table feeds (+ constants, which the engine materializes)
         self._token_input = None
         self._const_inputs = {}
         for t in self.decode_model._input_tensors:
-            if t.name == "positions":
+            if t.name in ("positions", "page_table"):
                 continue
             if hasattr(t, "constant_value"):
                 self._const_inputs[t.name] = (
@@ -145,7 +185,11 @@ class ServingEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
                eos_id: Optional[int] = None) -> Request:
-        """Queue one request (FCFS). Defaults come from the ServingSpec."""
+        """Queue one request (FCFS). Defaults come from the ServingSpec.
+        A request the paged pool could NEVER serve (worst case exceeds
+        the whole pool even capped at cache capacity) is rejected here,
+        like the oversized-prompt check — not left to head-block the
+        queue forever."""
         req = Request(
             prompt=[int(t) for t in prompt],
             max_new_tokens=(self.spec.max_new_tokens
@@ -153,6 +197,15 @@ class ServingEngine:
             temperature=0.0 if temperature is None else float(temperature),
             eos_id=self.spec.eos_id if eos_id is None else eos_id,
         )
+        mgr = self.block_manager
+        if mgr is not None:
+            needed = mgr.blocks_needed(len(req.prompt), req.max_new_tokens)
+            if needed > mgr.num_blocks - 1:
+                raise ValueError(
+                    f"request needs {needed} KV blocks worst-case but the "
+                    f"pool only has {mgr.num_blocks - 1} allocatable "
+                    f"blocks; raise kv_num_blocks (or lower "
+                    f"max_new_tokens / kv_block_size)")
         return self.scheduler.submit(req)
 
     # ------------------------------------------------------------ device step
@@ -177,6 +230,10 @@ class ServingEngine:
         dec = self.decode_model
         q = tokens.shape[1]
         xs = {self._token_input: tokens, "positions": positions}
+        if self.block_manager is not None:
+            mgr = self.block_manager
+            xs["page_table"] = np.asarray(
+                [mgr.table(i) for i in range(self.spec.slots)], np.int32)
         for name, (dims, dtype, value) in self._const_inputs.items():
             from ..fftype import dtype_to_jnp
 
@@ -232,40 +289,50 @@ class ServingEngine:
                 "dead", e["op"], e["phase"])
         self._numerics_reported = seen
 
-    # ------------------------------------------------------------ prefill
+    # ------------------------------------------------------------ paged
 
-    def _prefill(self, slot, req: Request):
-        """Walk the prompt through the decode step in bucketed chunks,
-        filling `slot`'s cache rows; the final chunk's last live logits
-        row samples the request's first token (TTFT lands here)."""
-        prompt = req.prompt
-        L = len(prompt)
-        chunks = plan_chunks(0, L, self.spec.prefill_chunk)
-        with telemetry.span("serve.prefill", slot=slot.index,
-                            prompt_tokens=L, chunks=len(chunks)):
-            for start, n in chunks:
-                b = self._bucket(n)
-                tokens = np.zeros((self.spec.slots, b), np.int32)
-                # scratch-row positions everywhere but the admitted slot's
-                # live elements: no other slot's cache state moves
-                positions = np.full((self.spec.slots, b), self.max_seq_len,
-                                    np.int32)
-                read_idx = np.zeros((self.spec.slots,), np.int32)
-                tokens[slot.index, :n] = prompt[start:start + n]
-                positions[slot.index, :n] = np.arange(
-                    start, start + n, dtype=np.int32)
-                read_idx[slot.index] = n - 1
-                next_tok = self._run_step(tokens, positions, read_idx)
-        self._prefill_tokens += L
-        self._prefill_calls += len(chunks)
-        slot.length = L
-        first = int(next_tok[slot.index])
-        self._decode_tokens += 1
-        if not self.scheduler.note_token(slot, first):
+    def _can_admit(self, req: Request) -> bool:
+        """Paged admission gate: reserve the request's worst case
+        (prompt + max_new_tokens in blocks) so a decode write can never
+        exhaust the pool mid-flight. A True answer IS the reservation —
+        the scheduler admits exactly when the gate passes."""
+        return self.block_manager.reserve(
+            req.request_id, len(req.prompt), req.max_new_tokens)
+
+    def _apply_copies(self, copies):
+        """Run this iteration's COW copies on the pool state in one
+        donated dispatch, padded to a power-of-two width with
+        scratch→scratch no-op pairs (one cached executable per bucket)."""
+        if not copies:
             return
-        self._note_completion(slot, req)
+        import jax.numpy as jnp
+
+        b = 1
+        while b < len(copies):
+            b *= 2
+        src = np.full((b,), SCRATCH_BLOCK, np.int32)
+        dst = np.full((b,), SCRATCH_BLOCK, np.int32)
+        for i, c in enumerate(copies):
+            src[i], dst[i] = c.src, c.dst
+        dec = self.decode_model
+        with telemetry.span("serve.cow_copy", blocks=len(copies)):
+            dec._state = self._copy_fn(
+                dec._state, jnp.asarray(src), jnp.asarray(dst))
+
+    def _prepare_writes(self, slot_positions: dict[int, range]):
+        """Paged pre-step bookkeeping: make every block this iteration
+        writes slot-owned (allocating / COW-copying via the BlockManager)
+        and apply the copies to the device pools BEFORE the step runs."""
+        if self.block_manager is None:
+            return
+        copies = []
+        for idx, positions in slot_positions.items():
+            copies.extend(self.block_manager.ensure_writable(idx, positions))
+        self._apply_copies(copies)
 
     def _note_completion(self, slot, req: Request):
+        if self.block_manager is not None:
+            self.block_manager.release(slot.index)
         telemetry.instant("serve.done", request=req.request_id,
                           reason=req.finish_reason)
         telemetry.event(
@@ -279,36 +346,114 @@ class ServingEngine:
     # ------------------------------------------------------------ iterate
 
     def step(self) -> list[Request]:
-        """ONE scheduler iteration (the Orca unit): admit pending requests
-        into free slots (prefilling each), then run one decode step for
-        every active slot. Returns the requests that completed during this
-        iteration."""
+        """ONE scheduler iteration (the Orca unit), ONE device call: admit
+        pending requests into free slots, pick AT MOST ONE prefill chunk
+        (the longest-waiting prefilling slot's next plan_chunks bucket),
+        and advance every decoding slot one token in the same call — the
+        chunked-prefill interleave that keeps long prompts from stalling
+        the continuous batch. Returns the requests that completed during
+        this iteration."""
         sched = self.scheduler
         done_before = len(sched.completed)
         with self._active():
-            for slot, req in sched.admissions():
-                self._prefill(slot, req)
-            active = sched.active_slots
+            gate = (self._can_admit
+                    if self.block_manager is not None else None)
+            for slot, req in sched.admissions(can_admit=gate):
+                if self.block_manager is not None:
+                    self.block_manager.bind_reservation(
+                        req.request_id, slot.index)
+            prefilling = [s for s in sched.slots if s.prefilling]
+            decoding = [s for s in sched.slots if s.decoding]
             telemetry.counter("serve.slots", {
-                "active": len(active), "queue": sched.queue_depth,
-                "occupancy": len(active) / max(1, len(sched.slots))})
-            if active:
-                tokens = np.zeros((self.spec.slots, 1), np.int32)
-                positions = np.full((self.spec.slots, 1), self.max_seq_len,
-                                    np.int32)
-                read_idx = np.zeros((self.spec.slots,), np.int32)
-                for s in active:
-                    tokens[s.index, 0] = s.last_token
-                    positions[s.index, 0] = s.length
-                with telemetry.span("serve.step", active=len(active)):
-                    next_tok = self._run_step(tokens, positions, read_idx)
-                self._decode_iterations += 1
-                for s in active:
-                    s.length += 1
-                    req = s.request
+                "active": len(prefilling) + len(decoding),
+                "queue": sched.queue_depth,
+                "occupancy": (len(prefilling) + len(decoding))
+                / max(1, len(sched.slots))})
+            if not prefilling and not decoding:
+                return sched.completed[done_before:]
+
+            # ---- choose this iteration's single prefill chunk (FCFS)
+            pre = min(prefilling, key=lambda s: s.admit_seq) \
+                if prefilling else None
+            n = b = 0
+            if pre is not None:
+                mgr = self.block_manager
+                if mgr is not None and pre.index not in mgr._tables:
+                    # LAZY page-table build: matched against the registry
+                    # at first-chunk time, so a burst of same-prefix
+                    # requests still shares — the first resident computed
+                    # and registered its blocks by the time the next one
+                    # prefills (one chunk per iteration, FCFS)
+                    skip = mgr.admit(pre.index, pre.request.prompt)
+                    pre.prefill_pos = skip
+                    if skip:
+                        telemetry.instant(
+                            "serve.prefix_hit", slot=pre.index,
+                            shared_tokens=skip,
+                            prompt_tokens=len(pre.request.prompt))
+                L = len(pre.request.prompt)
+                start, n = plan_chunks(
+                    pre.prefill_pos, L, self.spec.prefill_chunk)[0]
+                b = self._bucket(n)
+            q = max(b, 1)
+
+            tokens = np.zeros((self.spec.slots, q), np.int32)
+            # scratch positions everywhere but live elements: no other
+            # slot's cache state moves (row max_seq for the contiguous
+            # layout; the paged op routes clipped positions to the
+            # reserved scratch block)
+            positions = np.full((self.spec.slots, q), self.max_seq_len,
+                                np.int32)
+            read_idx = np.zeros((self.spec.slots,), np.int32)
+            writes: dict[int, range] = {}
+            if pre is not None:
+                prompt = pre.request.prompt
+                tokens[pre.index, :n] = prompt[start:start + n]
+                positions[pre.index, :n] = np.arange(
+                    start, start + n, dtype=np.int32)
+                read_idx[pre.index] = n - 1
+                writes[pre.index] = range(start, start + n)
+            for s in decoding:
+                tokens[s.index, 0] = s.last_token
+                positions[s.index, 0] = s.length
+                writes[s.index] = range(s.length, s.length + 1)
+            self._prepare_writes(writes)
+
+            span = telemetry.span(
+                "serve.prefill", slot=pre.index,
+                start=start, tokens=n,
+                prompt_tokens=len(pre.request.prompt),
+                decoding=len(decoding)) if pre is not None else \
+                telemetry.span("serve.step", active=len(decoding))
+            with span:
+                next_tok = self._run_step(tokens, positions, read_idx)
+
+            # ---- prefill bookkeeping (the chunk's writes landed)
+            if pre is not None:
+                self._prefill_tokens += n
+                self._prefill_calls += 1
+                pre.prefill_pos += n
+                req = pre.request
+                if pre.prefill_pos >= len(req.prompt):
+                    pre.length = len(req.prompt)
+                    pre.prefill_pos = None
+                    if self.block_manager is not None:
+                        self.block_manager.register_prompt(
+                            pre.index, req.prompt)
+                    # the final chunk's last live logits row samples the
+                    # request's first token (TTFT lands here)
                     self._decode_tokens += 1
-                    if self.scheduler.note_token(s, int(next_tok[s.index])):
-                        self._note_completion(s, req)
+                    if sched.note_token(pre, int(next_tok[pre.index])):
+                        self._note_completion(pre, req)
+            # ---- decode bookkeeping
+            if decoding:
+                self._decode_iterations += 1
+            for s in decoding:
+                s.length += 1
+                req = s.request
+                self._decode_tokens += 1
+                if sched.note_token(s, int(next_tok[s.index])):
+                    self._note_completion(s, req)
         return sched.completed[done_before:]
 
     def run_until_drained(self, max_iterations: int = 0) -> list[Request]:
@@ -351,6 +496,14 @@ class ServingEngine:
         self._prefill_calls = 0
         self._device_s = 0.0
         self._last_wall_s = 0.0
+        if self.block_manager is not None:
+            from .paged import PagedStats
+
+            fresh = PagedStats()
+            # live blocks carry over — the measured window's peak must
+            # still dominate what is resident when it opens
+            fresh.blocks_in_use_peak = self.block_manager.blocks_in_use
+            self.block_manager.stats = fresh
 
     def stats(self) -> dict:
         """Aggregate run metrics; rates are per chip of the decode mesh
@@ -373,7 +526,26 @@ class ServingEngine:
             "wall_s": wall,
             "device_s": self._device_s,
             "plan_source": self.decode_model._plan_source,
+            "kv_layout": self.spec.kv_layout,
         }
+        out["kv_hbm_bytes_per_layer"] = self.kv_bytes_per_layer()
+        if self.block_manager is not None:
+            mgr = self.block_manager
+            out.update({
+                "kv_block_size": mgr.block_size,
+                "kv_pool_blocks": mgr.num_blocks,
+                "kv_blocks_in_use_peak": mgr.stats.blocks_in_use_peak,
+                "prefix_hit_rate": mgr.stats.prefix_hit_rate,
+                "prefix_shared_tokens": mgr.stats.shared_tokens,
+                "cow_copies": mgr.stats.cow_copies,
+                # slots-at-fixed-HBM headline: how many contiguous
+                # max_seq slots the pool's PEAK working set would buy —
+                # the vLLM capacity-recovery metric
+                "kv_peak_vs_contiguous": (
+                    self.spec.slots * (self.max_seq_len + 1)
+                    / max(1, mgr.stats.blocks_in_use_peak
+                          * mgr.block_size)),
+            })
         if ttfts:
             out["ttft_p50_s"] = float(np.percentile(np.asarray(ttfts), 50))
             out["ttft_max_s"] = float(max(ttfts))
@@ -383,3 +555,21 @@ class ServingEngine:
             out["decode_tokens_per_sec_per_chip"] = (
                 self._decode_tokens / wall / self.num_chips)
         return out
+
+    def kv_bytes_per_layer(self) -> int:
+        """Resident KV bytes ONE attention layer holds under this
+        engine's layout (fp32, unsharded): the pool for paged — counted
+        once, however many page tables map its blocks — or the full
+        (slots, max_seq+1) region for contiguous. The serving bench's
+        slots-at-fixed-HBM comparison reads this."""
+        from ..fftype import OperatorType as OT
+
+        for n in self.decode_model.graph.topo_order():
+            if n.op_type == OT.OP_PAGED_INC_MULTIHEAD_ATTENTION:
+                p = n.params
+                return 2 * 4 * p.num_blocks * p.block_size * p.embed_dim
+            if n.op_type == OT.OP_INC_MULTIHEAD_ATTENTION:
+                p = n.params
+                return 2 * 4 * self.spec.slots * (p.max_seq_len + 1) \
+                    * p.embed_dim
+        return 0

@@ -1,0 +1,349 @@
+"""Paged KV-cache management: block pool, page tables, COW prefix reuse.
+
+The host-side policy half of the paged serving layout (the device half is
+ops/inc_attention.py's paged op + kernels/flash_attention.py's paged
+decode kernel). vLLM/PagedAttention (SOSP '23, PAPERS.md) is the
+grounding: KV rows live in fixed-size BLOCKS drawn from one shared pool;
+each slot owns a PAGE TABLE mapping its logical block index to a physical
+block. Three consequences this module implements:
+
+- **allocation at block granularity** — a slot holds ceil(length/bs)
+  blocks, not max_seq rows, so short generations stop paying long-context
+  HBM and the pool (not slots × max_seq) bounds concurrency;
+- **prefix sharing with refcounts** — prompt blocks are registered under
+  the FULL token prefix they encode (K/V of a row depends on every token
+  before it, so the key is the whole prefix, not the block's own tokens);
+  a new request whose prompt extends a registered prefix maps the shared
+  blocks into its own table (refcount++) and skips recomputing them —
+  N requests with one system prompt store and prefill it once;
+- **copy-on-write** — a write (decode append, or a prompt tail diverging
+  inside a shared partial block) targeting a block with refcount > 1
+  first copies it to a fresh block (`CopyPlan` — the engine runs the
+  device-side block copy), so divergence is paid only at the first
+  divergent write and only for the one block it lands in.
+
+Physical block 0 is the RESERVED SCRATCH BLOCK (never allocated, never
+freed): unallocated page-table entries point at it, and the device op
+routes position-clipped writes there — the paged equivalent of the
+contiguous layout's scratch row.
+
+Sharing is among LIVE residents: releasing a slot decrements its blocks'
+refcounts and a block returning to refcount 0 is freed and unregistered
+(refcount-exact reclamation — tested). There is no cross-time cache; the
+continuous batch's overlap is what the shared-prefix bench measures.
+
+Pure host code (no jax): unit-testable without a mesh.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+SCRATCH_BLOCK = 0
+
+
+def _chain(digest: bytes, tokens) -> bytes:
+    """One prefix-hash chaining step: digest of (parent digest, the next
+    run of tokens). K/V rows depend on the ENTIRE prefix before them, so
+    a block's content address must encode every earlier token — chaining
+    from the parent block's digest does that in O(block) per block
+    (vLLM's hash-based prefix caching scheme) instead of hashing the
+    whole O(L) prefix tuple per block."""
+    h = hashlib.sha256(digest)
+    for t in tokens:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return h.digest()
+
+
+@dataclass
+class CopyPlan:
+    """One COW copy the engine must run on the pool state BEFORE the next
+    device step writes: physical block `src` duplicated into `dst`."""
+
+    src: int
+    dst: int
+
+
+@dataclass
+class PagedStats:
+    prefix_queries: int = 0        # admissions that attempted a match
+    prefix_hits: int = 0           # admissions that shared >= 1 block
+    shared_tokens: int = 0         # prompt tokens served from shared blocks
+    prompt_tokens: int = 0         # total prompt tokens admitted
+    cow_copies: int = 0
+    blocks_in_use_peak: int = 0
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of admitted prompt tokens whose K/V came from a shared
+        block instead of being recomputed and re-stored."""
+        if self.prompt_tokens == 0:
+            return 0.0
+        return self.shared_tokens / self.prompt_tokens
+
+
+class BlockManager:
+    """Refcounted block pool + per-slot page tables + prefix registry."""
+
+    def __init__(self, num_blocks: int, block_size: int, table_width: int,
+                 sharing: bool = True):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (scratch + 1 allocatable), got "
+                f"{num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.table_width = int(table_width)
+        self.sharing = bool(sharing)  # False = paged-without-reuse ablation
+        # LIFO free list: hot blocks are reused while still cached
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self._refcount: dict[int, int] = {}
+        # admission reservations (worst-case fresh blocks per resident),
+        # keyed by request id until bind_reservation moves the key to the
+        # slot index: Σ reservations <= free blocks at all times, so a
+        # decode write can NEVER exhaust the pool mid-flight — admission
+        # is the only place pool pressure is felt (FCFS head-blocking)
+        self._reserved: dict = {}
+        # slot index -> logical->physical list (allocated prefix only)
+        self._tables: dict[int, list[int]] = {}
+        # prefix registry: chained digest of prompt[:end] (see _chain) ->
+        # physical block holding rows [end - fill, end); a partial tail's
+        # digest covers its exact extent
+        self._registry: dict[bytes, int] = {}
+        self._block_key: dict[int, bytes] = {}  # reverse map for unregister
+        self.stats = PagedStats()
+
+    # ------------------------------------------------------------ queries
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_blocks - 1 - len(self._free)
+
+    def table(self, slot: int) -> list[int]:
+        """The slot's page table padded to table_width with SCRATCH (the
+        row the engine feeds the device op)."""
+        t = self._tables.get(slot, [])
+        return t + [SCRATCH_BLOCK] * (self.table_width - len(t))
+
+    def refcount(self, block: int) -> int:
+        return self._refcount.get(block, 0)
+
+    def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Worst-case fresh blocks a request can consume over its life:
+        every block of [0, prompt + new), CAPPED at the logical capacity
+        (table_width) — generation physically stops at max_seq rows (the
+        scheduler's `length` completion rule), so a huge max_new_tokens
+        must not inflate the reservation past what the slot can ever
+        write. Prefix sharing only ever LOWERS the real draw (a slot's
+        shared blocks cost nothing, and at most one COW replaces a shared
+        block with a fresh one), so reserving this at admission makes
+        mid-flight exhaustion impossible."""
+        return min(-(-(prompt_len + max_new_tokens) // self.block_size),
+                   self.table_width)
+
+    @property
+    def reserved_total(self) -> int:
+        return sum(self._reserved.values())
+
+    def reserve(self, request_id, prompt_len: int,
+                max_new_tokens: int) -> bool:
+        """Admission gate: reserve the request's worst case against the
+        pool. False = not enough headroom (the caller keeps the request
+        queued — FCFS head-blocking, so admission order never depends on
+        pool pressure in a way that could reorder token streams)."""
+        needed = self.blocks_needed(prompt_len, max_new_tokens)
+        if self.free_blocks - self.reserved_total < needed:
+            return False
+        self._reserved[("req", request_id)] = needed
+        return True
+
+    def bind_reservation(self, request_id, slot: int):
+        """Move an admission reservation onto the slot that won it (the
+        scheduler assigns slots after the gate passes)."""
+        n = self._reserved.pop(("req", request_id), None)
+        if n is not None:
+            self._reserved[slot] = n
+
+    # ------------------------------------------------------------ intake
+
+    def _match(self, prompt: list[int]):
+        """(covered, [block digests]): the longest registered prefix of
+        `prompt` at block granularity — full blocks at every block_size
+        boundary (digest chained per block), then the longest registered
+        PARTIAL extent inside the next block (its digest covers the exact
+        extent — a prompt of 6 registered tokens serves both its twin and
+        a longer prompt extending it, the latter COWing on its first tail
+        write). Digests are returned so admit() maps without rehashing."""
+        bs = self.block_size
+        L = len(prompt)
+        covered = 0
+        keys: list[bytes] = []
+        if not self.sharing:
+            return 0, keys
+        digest = b""
+        for end in range(bs, L + 1, bs):
+            nxt = _chain(digest, prompt[end - bs:end])
+            if nxt not in self._registry:
+                break
+            digest = nxt
+            keys.append(nxt)
+            covered = end
+        best = None
+        for end in range(covered + 1, min(covered + bs - 1, L) + 1):
+            part = _chain(digest, prompt[covered:end])
+            if part in self._registry:
+                best = (end, part)
+        if best is not None:
+            covered = best[0]
+            keys.append(best[1])
+        return covered, keys
+
+    def match_prefix(self, prompt: list[int]) -> int:
+        """Covered token count of the longest registered prefix (see
+        `_match`)."""
+        return self._match(prompt)[0]
+
+    def admit(self, slot: int, prompt: list[int]) -> int:
+        """Build `slot`'s page table: map every shared prefix block
+        (refcount++), leave the rest for prefill writes to allocate.
+        Called LAZILY — at the slot's first prefill chunk, not at
+        admission — so a burst of same-prefix requests still shares: by
+        the time the second request prefills, the first has computed and
+        registered its blocks. Returns the prefill cursor: prompt tokens
+        whose K/V need no recomputation, capped at len(prompt) - 1
+        because the final token's logits row samples the first generated
+        token (its re-write into a fully-shared block is the first
+        COW)."""
+        if slot in self._tables:
+            raise ValueError(f"slot {slot} already holds a table")
+        L = len(prompt)
+        covered, keys = self._match(prompt)
+        self.stats.prefix_queries += 1
+        table: list[int] = []
+        for key in keys:
+            # full blocks, plus the shared partial tail (mapped
+            # read-only; the first write into it COWs)
+            blk = self._registry[key]
+            self._refcount[blk] += 1
+            table.append(blk)
+        self._tables[slot] = table
+        skip = min(covered, L - 1)
+        self.stats.prompt_tokens += L
+        self.stats.shared_tokens += skip
+        if skip:
+            self.stats.prefix_hits += 1
+        return skip
+
+    # ------------------------------------------------------------ writes
+
+    def _alloc(self, slot: int) -> int:
+        if not self._free:
+            raise RuntimeError(
+                "paged KV pool exhausted — the admission reservations "
+                "(reserve/blocks_needed) must prevent this")
+        blk = self._free.pop()
+        self._refcount[blk] = 1
+        if slot in self._reserved:
+            self._reserved[slot] = max(0, self._reserved[slot] - 1)
+        self.stats.blocks_in_use_peak = max(
+            self.stats.blocks_in_use_peak, self.blocks_in_use)
+        return blk
+
+    def ensure_writable(self, slot: int, positions) -> list[CopyPlan]:
+        """Guarantee every logical block covering `positions` is owned
+        (refcount 1) by `slot`, allocating fresh blocks past the table end
+        and COW-copying shared ones. Returns the copies the engine must
+        apply to the device pool BEFORE the step that writes. Also
+        unregisters any owned block about to be written (its content — and
+        therefore its prefix key — is changing)."""
+        table = self._tables.get(slot)
+        if table is None:
+            raise ValueError(f"slot {slot} has no table")
+        bs = self.block_size
+        copies: list[CopyPlan] = []
+        for lb in sorted({int(p) // bs for p in positions}):
+            if lb >= self.table_width:
+                raise ValueError(
+                    f"position past the logical capacity "
+                    f"({self.table_width * bs} rows)")
+            while len(table) <= lb:
+                table.append(self._alloc(slot))
+            blk = table[lb]
+            if self._refcount.get(blk, 0) > 1:
+                fresh = self._alloc(slot)
+                self._refcount[blk] -= 1
+                table[lb] = fresh
+                copies.append(CopyPlan(src=blk, dst=fresh))
+                self.stats.cow_copies += 1
+            elif blk in self._block_key:
+                # sole owner writing into a registered block: future
+                # prompts must not match stale content
+                self._registry.pop(self._block_key.pop(blk), None)
+        return copies
+
+    def register_prompt(self, slot: int, prompt: list[int]):
+        """Publish `slot`'s prompt blocks for prefix sharing (called once
+        when its prefill completes): every full block under the full-
+        prefix key, plus the partial tail. Blocks already registered (the
+        shared source) keep their entry."""
+        if not self.sharing:
+            return
+        table = self._tables.get(slot, [])
+        bs = self.block_size
+        L = len(prompt)
+        digest = b""
+        for lb in range(len(table)):
+            end = min((lb + 1) * bs, L)
+            if end <= lb * bs:
+                break
+            key = _chain(digest, prompt[lb * bs:end])
+            if end == (lb + 1) * bs:
+                digest = key  # full block: the next block chains from it
+            if key not in self._registry:
+                blk = table[lb]
+                if blk in self._block_key:
+                    continue  # already published under another key
+                self._registry[key] = blk
+                self._block_key[blk] = key
+
+    # ------------------------------------------------------------ release
+
+    def release(self, slot: int):
+        """Drop the slot's table; refcounts decrement and blocks reaching
+        zero return to the free list (and leave the prefix registry)."""
+        self._reserved.pop(slot, None)
+        table = self._tables.pop(slot, None)
+        if table is None:
+            return
+        for blk in table:
+            n = self._refcount.get(blk, 0) - 1
+            if n > 0:
+                self._refcount[blk] = n
+                continue
+            self._refcount.pop(blk, None)
+            self._registry.pop(self._block_key.pop(blk, None), None)
+            self._free.append(blk)
+
+    def check_invariants(self):
+        """Debug/test hook: every block is free xor refcounted, the
+        scratch block is neither, and table entries are refcounted."""
+        free = set(self._free)
+        assert SCRATCH_BLOCK not in free
+        assert SCRATCH_BLOCK not in self._refcount
+        assert not (free & set(self._refcount)), "block both free and live"
+        for slot, table in self._tables.items():
+            for blk in table:
+                assert self._refcount.get(blk, 0) >= 1, \
+                    f"slot {slot} maps unrefcounted block {blk}"
+        counted = sum(1 for _ in self._refcount)
+        assert counted + len(free) == self.num_blocks - 1, \
+            "pool accounting leak"
+        assert self.reserved_total <= self.free_blocks, \
+            "reservations exceed the free pool"

@@ -59,20 +59,35 @@ class Slot:
         self.request: Optional[Request] = None
         self.length = 0  # cache rows filled (prompt + generated fed back)
         self.last_token = 0  # next decode iteration's input token
+        # chunked prefill cursor: prompt tokens already written to the
+        # cache (admission sets it — nonzero when a shared prefix was
+        # mapped instead of recomputed); None once decoding
+        self.prefill_pos: Optional[int] = None
+        self.admit_seq = 0  # admission order (prefill scheduling is FCFS)
 
     @property
     def free(self) -> bool:
         return self.request is None
 
+    @property
+    def prefilling(self) -> bool:
+        return self.request is not None and self.prefill_pos is not None
+
+    @property
+    def decoding(self) -> bool:
+        return self.request is not None and self.prefill_pos is None
+
     def assign(self, request: Request):
         self.request = request
         self.length = 0
         self.last_token = 0
+        self.prefill_pos = 0
 
     def release(self) -> Request:
         req = self.request
         self.request = None
         self.length = 0
+        self.prefill_pos = None
         return req
 
 
@@ -86,6 +101,7 @@ class ContinuousBatchingScheduler:
         self.max_seq_len = int(max_seq_len)
         self.pending: list[Request] = []
         self.completed: list[Request] = []
+        self._admit_counter = 0  # admission order (prefill FCFS key)
 
     # ------------------------------------------------------------ intake
 
@@ -117,15 +133,24 @@ class ContinuousBatchingScheduler:
     def drained(self) -> bool:
         return not self.pending and not self.active_slots
 
-    def admissions(self) -> list[tuple[Slot, Request]]:
+    def admissions(self, can_admit=None) -> list[tuple[Slot, Request]]:
         """Admit pending requests into free slots (FCFS), one batch of
-        admissions per iteration — the Orca admission point."""
+        admissions per iteration — the Orca admission point. `can_admit`
+        (optional callable Request -> bool) is the engine's resource gate
+        (paged: enough free pool blocks for the request's worst case); a
+        False answer BLOCKS the queue head rather than admitting a later
+        request past it, so admission order — and therefore slot
+        assignment and token streams — never depends on pool pressure."""
         out = []
         for slot in self.free_slots:
             if not self.pending:
                 break
+            if can_admit is not None and not can_admit(self.pending[0]):
+                break
             req = self.pending.pop(0)
             slot.assign(req)
+            self._admit_counter += 1
+            slot.admit_seq = self._admit_counter
             out.append((slot, req))
         return out
 

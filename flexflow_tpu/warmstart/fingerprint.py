@@ -61,6 +61,11 @@ _SEARCH_CONFIG_FIELDS = (
     # training compile's (the graphs differ structurally too, but the
     # mode is the cheap, explicit discriminator)
     "computation_mode",
+    # KV-cache layout (--serve-kv-layout): contiguous and paged decode
+    # graphs must never share a plan address — the pool/page-table
+    # tensors differ structurally too, but as with computation_mode the
+    # field is the explicit discriminator the round-trip test pins
+    "serve_kv_layout",
 )
 
 

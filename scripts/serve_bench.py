@@ -1,15 +1,28 @@
-"""Serving benchmark: requests/s/chip + decode tokens/s/chip.
+"""Serving benchmark: requests/s/chip, decode tokens/s/chip, and the
+paged-KV headline — slots-at-fixed-HBM under a shared-prefix trace.
 
-The standalone driver for the ROADMAP's serving metric ("target a
-requests/sec/chip bench leg next to the training slope metric") —
-bench.py embeds the same measurement as its serving leg; this script runs
-it alone with tunable load, for serving-focused profiling:
+The standalone driver for the ROADMAP's serving metrics — bench.py embeds
+the same measurements as its serving leg; this script runs them alone
+with tunable load, for serving-focused profiling:
 
   python scripts/serve_bench.py [--requests N] [--slots S]
-      [--prompt-len P] [--max-new-tokens T] [--telemetry-dir DIR]
+      [--prompt-len P] [--max-new-tokens T] [--shared-prefix K]
+      [--layout paged|contiguous|both] [--telemetry-dir DIR]
       [flexflow flags]
 
-Prints one JSON line per metric, the full stats payload last.
+--shared-prefix K (default: prompt-len // 2) prepends one K-token system
+prompt to every request — the N-users-one-system-prompt trace the paged
+layout's copy-on-write prefix sharing exists for. With --layout both
+(default) the same trace runs through both KV layouts and the report
+carries, next to each layout's req/s/chip:
+
+  - prefix_hit_rate / cow_copies (paged),
+  - kv_hbm_bytes_per_layer resident per layout, and
+  - slots_at_fixed_hbm: contiguous KV rows ÷ the paged PEAK working set
+    — how many more concurrent max_seq slots the pool recovers at equal
+    HBM (vLLM's capacity metric; the ISSUE 11 acceptance bar is >= 2x).
+
+Prints one JSON line per metric, the full per-layout payload last.
 """
 
 import json
@@ -28,13 +41,48 @@ def _pop_int(argv, flag, default):
     return default
 
 
+def _pop_str(argv, flag, default):
+    if flag in argv:
+        i = argv.index(flag)
+        val = argv[i + 1]
+        del argv[i:i + 2]
+        return val
+    return default
+
+
+def run_trace(ff, layout, prompts, slots, max_new, **serve_kw):
+    """Drain `prompts` through a fresh engine of `layout`; returns
+    (completions, stats) with the measured window warmed + reset."""
+    kw = {"max_new_tokens": max_new, "kv_layout": layout, **serve_kw}
+    if slots:
+        kw["slots"] = slots
+    engine = ff.serve(**kw)
+    # warm the bucket/decode/copy executables so the measured drain is
+    # steady state
+    engine.generate(prompts[:1])
+    engine.reset_stats()
+    for p in prompts:
+        engine.submit(p)
+    engine.run_until_drained()
+    return [r.generated for r in engine.scheduler.completed], engine.stats()
+
+
 def main():
     argv = sys.argv[1:]
     n_requests = _pop_int(argv, "--requests", 16)
     slots = _pop_int(argv, "--slots", 0)  # 0 → FFConfig default
     prompt_len = _pop_int(argv, "--prompt-len", 8)
     max_new = _pop_int(argv, "--max-new-tokens", 16)
+    shared_prefix = _pop_int(argv, "--shared-prefix", prompt_len // 2)
+    kv_block_size = _pop_int(argv, "--kv-block-size", 0)
+    layout = _pop_str(argv, "--layout", "both")
     sys.argv = [sys.argv[0]] + argv
+    if not kv_block_size:
+        # block granularity must divide INTO the shared prefix for the
+        # sharing to be visible; half the prefix keeps at least one full
+        # shared block plus a partial tail (the COW case)
+        kv_block_size = max(2, min(16, shared_prefix // 2)) \
+            if shared_prefix >= 4 else 0
 
     import jax
     import numpy as np
@@ -59,32 +107,56 @@ def main():
     ff.compile(optimizer=SGDOptimizer(lr=0.01),
                loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
 
-    kw = {"max_new_tokens": max_new}
-    if slots:
-        kw["slots"] = slots
-    engine = ff.serve(**kw)
+    # the shared-prefix trace: one system prompt opens every request
+    # (served alone first so the partial tail block registers and later
+    # extensions exercise COW), distinct suffixes after it
     rs = np.random.RandomState(0)
-    prompts = [rs.randint(1, lm.vocab_size, prompt_len).tolist()
-               for _ in range(n_requests)]
-    # warm the bucket + decode executables so the measured drain is steady
-    # state, then reset accounting by building the measured run fresh
-    engine.generate(prompts[:1])
-    engine.reset_stats()
-    for p in prompts:
-        engine.submit(p)
-    engine.run_until_drained()
-    stats = engine.stats()
-    print(json.dumps({
-        "metric": "serving_requests_per_sec_per_chip",
-        "value": round(stats.get("requests_per_sec_per_chip", 0.0), 4),
-        "unit": "req/s",
-    }))
-    print(json.dumps({
-        "metric": "serving_decode_tokens_per_sec_per_chip",
-        "value": round(stats.get("decode_tokens_per_sec_per_chip", 0.0), 2),
-        "unit": "tokens/s",
-    }))
-    print(json.dumps(stats))
+    system = rs.randint(1, lm.vocab_size, shared_prefix).tolist()
+    tail = max(1, prompt_len - shared_prefix)
+    prompts = [
+        system + rs.randint(1, lm.vocab_size, tail).tolist()
+        if (i or not system) else list(system)
+        for i in range(n_requests)]
+
+    serve_kw = {"kv_block_size": kv_block_size} if kv_block_size else {}
+    layouts = ("paged", "contiguous") if layout == "both" else (layout,)
+    results = {}
+    completions = {}
+    for lay in layouts:
+        completions[lay], results[lay] = run_trace(
+            ff, lay, prompts, slots, max_new,
+            **(serve_kw if lay == "paged" else {}))
+        print(json.dumps({
+            "metric": f"serving_requests_per_sec_per_chip_{lay}",
+            "value": round(
+                results[lay].get("requests_per_sec_per_chip", 0.0), 4),
+            "unit": "req/s",
+        }))
+    if layout == "both" and completions["paged"] != completions["contiguous"]:
+        print("serve_bench: FAIL — paged completions diverge from "
+              "contiguous", file=sys.stderr)
+        sys.exit(1)
+
+    payload = {"shared_prefix": shared_prefix, "requests": n_requests,
+               "prompt_len": prompt_len, "max_new_tokens": max_new,
+               **{lay: results[lay] for lay in layouts}}
+    if "paged" in results:
+        st = results["paged"]
+        print(json.dumps({
+            "metric": "serving_prefix_hit_rate",
+            "value": round(st.get("prefix_hit_rate", 0.0), 4),
+        }))
+        if "contiguous" in results:
+            # the engine computes this under `kv_peak_vs_contiguous`
+            # (serving/engine.py stats()) — one definition, read here
+            payload["slots_at_fixed_hbm"] = round(
+                st["kv_peak_vs_contiguous"], 4)
+            print(json.dumps({
+                "metric": "serving_slots_at_fixed_hbm",
+                "value": payload["slots_at_fixed_hbm"],
+                "unit": "x contiguous",
+            }))
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

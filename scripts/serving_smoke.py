@@ -1,16 +1,22 @@
 """Serving smoke: serve synthetic requests on the CPU mesh, validate
 artifacts — the CI gate for the serving subsystem (docs/serving.md).
 
-Runs a small Transformer LM, builds the serving engine TWICE, and asserts
+Runs a small Transformer LM, builds the serving engine for BOTH KV
+layouts (paged — the default — twice, plus contiguous), and asserts
 
   - every request completes, with tokens and a finish reason;
-  - greedy decode is token-identical between the two engines;
-  - telemetry carries the serving surface: serve.compile (plan_source),
-    one serve.request per completion (TTFT > 0), a serve.summary with
-    requests/s/chip + decode tokens/s/chip, and the serve.prefill /
-    serve.step trace spans;
-  - with --warmstart-dir, the SECOND engine's compile is a plan-cache hit
-    (plan_source == "cache") — the serving acceptance criterion.
+  - greedy decode is token-identical between the two paged engines AND
+    bit-for-bit identical between the paged and contiguous layouts;
+  - a shared-prefix trace (every prompt opens with one system prompt)
+    reports prefix_hit_rate > 0 and at least one COW copy, with a paged
+    peak working set smaller than the contiguous cache;
+  - telemetry carries the serving surface: serve.compile (plan_source,
+    kv_layout), one serve.request per completion (TTFT > 0), a
+    serve.summary with requests/s/chip + decode tokens/s/chip +
+    prefix_hit_rate, and the serve.prefill / serve.step trace spans;
+  - with --warmstart-dir, the SECOND paged engine's compile is a
+    plan-cache hit (plan_source == "cache") while the contiguous compile
+    still searches — the layouts never share a cache address.
 
 Usage:
   python scripts/serving_smoke.py --telemetry-dir OUT \
@@ -104,21 +110,66 @@ def main():
             fail(f"second serving compile expected plan_source=cache, got "
                  f"{engine2.decode_model._plan_source!r} (warm-start plan "
                  f"cache missed)")
+
+    # ---- layout parity: the contiguous ablation layout must be
+    # bit-for-bit token-identical to the paged default (and with a
+    # warm-start dir its plan must MISS the paged entry: the layouts
+    # never share a cache address)
+    contig = ff.serve(kv_layout="contiguous", **serve_kw)
+    if contig.generate(prompts) != outputs:
+        fail("contiguous layout's completions differ from paged "
+             "(layouts must be bit-for-bit identical)")
+    if search_overrides["warmstart_dir"] and \
+            contig.decode_model._plan_source == "cache":
+        fail("contiguous compile hit the paged plan-cache entry — the "
+             "kv layout is missing from the fingerprint")
+
+    # ---- shared-prefix trace: a 9-token system prompt (deliberately NOT
+    # block-aligned at kv_block_size=4, so extensions diverge INSIDE its
+    # partial tail block and must COW), served alone first, then extended
+    # by every later request; the paged engine must report prefix reuse
+    # and a peak working set under the contiguous cache's footprint
+    system = rs.randint(1, lm.vocab_size, 9).tolist()
+    trace = [list(system)] + [
+        system + rs.randint(1, lm.vocab_size,
+                            rs.randint(1, 5)).tolist()
+        for _ in range(NUM_REQUESTS - 1)]
+    paged_sp = ff.serve(kv_block_size=4, **serve_kw)
+    sp_out = paged_sp.generate(trace)
+    sp_stats = paged_sp.stats()
+    if not sp_stats.get("prefix_hit_rate", 0) > 0:
+        fail(f"shared-prefix trace reported no prefix reuse: {sp_stats}")
+    if not sp_stats.get("cow_copies", 0) > 0:
+        fail("shared-prefix trace triggered no copy-on-write")
+    peak_rows = (sp_stats["kv_blocks_in_use_peak"]
+                 * sp_stats["kv_block_size"])
+    contig_rows = sp_stats["slots"] * (sp_stats["max_seq_len"] + 1)
+    if not sp_stats.get("kv_peak_vs_contiguous", 0) > 1:
+        fail(f"paged peak KV rows {peak_rows} not under the contiguous "
+             f"footprint {contig_rows}")
+    contig_sp = ff.serve(kv_layout="contiguous", **serve_kw)
+    if contig_sp.generate(trace) != sp_out:
+        fail("shared-prefix trace: paged completions diverge from "
+             "contiguous (COW reuse must be bit-for-bit invisible)")
     ff.get_telemetry().close()
 
     # ---- artifact validation
     tdir = config.telemetry_dir
     recs = read_jsonl(os.path.join(tdir, "metrics.jsonl"))
     compiles = [r for r in recs if r["kind"] == "serve.compile"]
-    if len(compiles) != 2:
-        fail(f"expected 2 serve.compile records, got {len(compiles)}")
+    if len(compiles) != 5:
+        fail(f"expected 5 serve.compile records, got {len(compiles)}")
     for c in compiles:
-        for field in ("plan_source", "slots", "max_seq_len", "duration_s"):
+        for field in ("plan_source", "slots", "max_seq_len", "duration_s",
+                      "kv_layout"):
             if field not in c:
                 fail(f"serve.compile missing {field}: {c}")
+    layouts = [c["kv_layout"] for c in compiles]
+    if layouts != ["paged", "paged", "contiguous", "paged", "contiguous"]:
+        fail(f"unexpected serve.compile kv_layout sequence: {layouts}")
     reqs = [r for r in recs if r["kind"] == "serve.request"]
-    if len(reqs) != 2 * NUM_REQUESTS:
-        fail(f"expected {2 * NUM_REQUESTS} serve.request records, "
+    if len(reqs) != 5 * NUM_REQUESTS:
+        fail(f"expected {5 * NUM_REQUESTS} serve.request records, "
              f"got {len(reqs)}")
     for r in reqs:
         if not (r.get("ttft_s") or 0) > 0:
@@ -126,14 +177,19 @@ def main():
         if "finish_reason" not in r or "new_tokens" not in r:
             fail(f"malformed serve.request: {r}")
     summaries = [r for r in recs if r["kind"] == "serve.summary"]
-    if len(summaries) < 2:
-        fail(f"expected >=2 serve.summary records, got {len(summaries)}")
+    if len(summaries) < 5:
+        fail(f"expected >=5 serve.summary records, got {len(summaries)}")
     for field in ("requests_per_sec_per_chip",
                   "decode_tokens_per_sec_per_chip", "ttft_p50_s",
                   "decode_iterations"):
         if not (summaries[-1].get(field, 0) > 0):
             fail(f"serve.summary field {field} missing/zero: "
                  f"{summaries[-1]}")
+    # the shared-prefix paged drain is the second-to-last summary; its
+    # reuse metrics must have landed in the archived artifact too
+    paged_summ = [s for s in summaries if s.get("kv_layout") == "paged"]
+    if not any(s.get("prefix_hit_rate", 0) > 0 for s in paged_summ):
+        fail("no archived serve.summary carries prefix_hit_rate > 0")
 
     with open(os.path.join(tdir, "trace.json")) as f:
         names = {e["name"] for e in json.load(f)["traceEvents"]}
@@ -142,8 +198,12 @@ def main():
             fail(f"trace missing span {span!r} (have {sorted(names)})")
 
     summ = summaries[-1]
-    print(f"serving_smoke: OK — {NUM_REQUESTS} requests x2 engines, "
+    print(f"serving_smoke: OK — {NUM_REQUESTS} requests x5 engines "
+          f"(paged x3 + contiguous x2, bit-identical), "
           f"plan {compiles[0]['plan_source']}->{compiles[1]['plan_source']}, "
+          f"prefix_hit_rate={sp_stats['prefix_hit_rate']:.2f} "
+          f"cow={sp_stats['cow_copies']} "
+          f"kv_peak_rows={peak_rows}/{contig_rows} "
           f"ttft_p50={summ['ttft_p50_s'] * 1e3:.1f}ms "
           f"req/s/chip={summ['requests_per_sec_per_chip']:.2f} "
           f"decode tok/s/chip={summ['decode_tokens_per_sec_per_chip']:.1f}")

@@ -90,6 +90,33 @@ def _probe_pallas_decode():
         return f"{type(e).__name__}: {e}"
 
 
+def _probe_pallas_paged_decode():
+    """Run a tiny PAGED decode-attention kernel call (interpret mode on
+    CPU) — the paged serving flash path (kernels/flash_attention.
+    paged_flash_decode_attention), whose scalar-prefetched page-table
+    BlockSpecs (PrefetchScalarGridSpec) are a separate capability from
+    the plain decode kernel. Returns None when supported, else the
+    failure reason."""
+    try:
+        import jax.numpy as jnp
+
+        from flexflow_tpu.kernels.flash_attention import (
+            paged_flash_decode_attention,
+        )
+
+        # 16 blocks x 8 rows >= the 128-row einsum-fallback gate, so the
+        # probe exercises the real Pallas paged kernel
+        q = jnp.zeros((1, 1, 32), jnp.float32)
+        pool = jnp.zeros((17, 8, 32), jnp.float32)
+        tbl = jnp.arange(1, 17, dtype=jnp.int32)[None, :]
+        jax.block_until_ready(paged_flash_decode_attention(
+            q, pool, pool, tbl, jnp.ones((1,), jnp.int32), num_heads=1,
+            interpret=True))
+        return None
+    except Exception as e:  # noqa: BLE001 - any env failure is the answer
+        return f"{type(e).__name__}: {e}"
+
+
 def _probe_shard_map():
     """The parallel/ modules (ring attention, pipeline) use jax.shard_map,
     which older jax only ships as jax.experimental.shard_map."""
@@ -111,6 +138,9 @@ _CAPABILITIES = [
      _probe_pallas_flash),
     ("pallas/flash-decode", re.compile(r"pallas|Pallas|CompilerParams"),
      _probe_pallas_decode),
+    ("pallas/paged-decode",
+     re.compile(r"pallas|Pallas|CompilerParams|PrefetchScalarGridSpec"),
+     _probe_pallas_paged_decode),
     ("shard_map", re.compile(r"shard_map"), _probe_shard_map),
 ]
 _probe_results: dict = {}
