@@ -92,11 +92,16 @@ class AnalysisContext:
 
     def __init__(self, machine=None, cost_model=None, opt_slots: int = 1,
                  update_specs=None, training: bool = True,
-                 hbm_cap_bytes: float = 0.0, config=None):
+                 hbm_cap_bytes: float = 0.0, config=None,
+                 update_stage: int = 0):
         self.machine = machine
         self.cost_model = cost_model
         self.opt_slots = opt_slots
         self.update_specs = update_specs or {}
+        # weight-update sharding stage the executor runs (0 | 2 | 3):
+        # stage 3 drops the resident gathered weight copies from the
+        # persistent set and adds the two-layers-in-flight transient
+        self.update_stage = update_stage
         self.training = training
         self.hbm_cap_bytes = hbm_cap_bytes
         # FFConfig (or None): the dtype-flow pass reads the
@@ -151,6 +156,7 @@ def context_for_model(model, cost_model=None) -> AnalysisContext:
                        if model.optimizer is not None else 1))
         upd = getattr(model, "_update_sharding", None) or {}
         cost_model.update_sharding = bool(upd.get("enabled"))
+        cost_model.param_gather = upd.get("stage", 0) == 3
         cost_model.overlap_update = (
             bool(upd.get("enabled"))
             and bool(model.config.overlap_collectives))
@@ -163,6 +169,8 @@ def context_for_model(model, cost_model=None) -> AnalysisContext:
                    if model.optimizer is not None else 1),
         update_specs=(model.executor.update_specs
                       if model.executor is not None else {}),
+        update_stage=(model.executor.update_stage
+                      if model.executor is not None else 0),
         training=(model.config.computation_mode
                   == CompMode.COMP_MODE_TRAINING),
         hbm_cap_bytes=cap,

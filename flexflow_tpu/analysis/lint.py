@@ -100,6 +100,8 @@ DONATED_CALLEES = {
     "_step_fn": (1,),                 # build_decode_step (KV-cache state)
     "_decode_step": (1,),
     "_copy_fn": (0,),                 # build_block_copy (paged KV pools)
+    "_gather_fn": (0,),               # build_param_gather (stage-3 tree)
+    "gather_fn": (0,),
 }
 
 _HASH_FN_HINTS = ("fingerprint", "signature", "digest", "_sha", "hash")

@@ -57,16 +57,26 @@ def _logical_assignment(pt):
 
 
 def analyze(graph, mesh, *, opt_slots: int = 1, update_specs=None,
-            training: bool = True) -> dict:
+            training: bool = True, update_stage: int = 0) -> dict:
     """Static per-chip memory model of one training (or inference) step.
     Returns {persistent_bytes, peak_bytes, peak_at, timeline,
-    weight_bytes, activation_bytes}."""
+    weight_bytes, activation_bytes, gather_peak_bytes}.
+
+    `update_stage` 3 (ZeRO-3/FSDP) changes the sharded weights'
+    accounting: the resident gathered compute copy leaves the persistent
+    set (weights live 1/shards at rest) and each op's gathered copies
+    become a TRANSIENT in the timeline — the op's own gather plus the
+    one-layer-ahead prefetch, so at most two gathered layers are in
+    flight at any point of the fwd (and of the bwd, which re-gathers in
+    reverse order). This is the accounting the acceptance criterion's
+    "1/shards at rest + transient gather" check verifies."""
     from ..search.cost_model import dtype_bytes
     from ..parallel.ops import _spec_assignment
 
     axis_sizes = {k: int(v) for k, v in dict(mesh.shape).items()}
     update_specs = update_specs or {}
     order = graph.topo_order()
+    stage3 = update_stage >= 3 and training
 
     # ---- persistent: masters + grads + optimizer slots (+ the gathered
     # compute copy under a sharded update), per the op_cost rules. An
@@ -76,6 +86,10 @@ def analyze(graph, mesh, *, opt_slots: int = 1, update_specs=None,
     # and trip the OOM gate on a serving launch that actually fits.
     persistent = 0.0
     weight_bytes = 0.0
+    # stage 3: per owning node, the FULL bytes of its gathered weight
+    # copies — a transient charged while the node (or its one-ahead
+    # prefetch) is in flight, not a persistent resident
+    gather_of: dict[int, float] = {}
     for node in order:
         if getattr(node, "weight_source", None):
             continue  # tied weights live under the source node
@@ -92,9 +106,18 @@ def analyze(graph, mesh, *, opt_slots: int = 1, update_specs=None,
                 rest = _shard_bytes(
                     ws.shape, _spec_assignment(upd[0], len(ws.shape)),
                     axis_sizes, el)
-                # gathered compute copy + master/grad/slots at 1/shards
-                persistent += wb + rest * (2 + opt_slots)
-                weight_bytes += wb + rest * (2 + opt_slots)
+                if stage3:
+                    # weights 1/shards at rest; the gathered copy is a
+                    # transient (two layers in flight, charged below)
+                    persistent += rest * (2 + opt_slots)
+                    weight_bytes += rest * (2 + opt_slots)
+                    gather_of[node.guid] = gather_of.get(
+                        node.guid, 0.0) + wb
+                else:
+                    # gathered compute copy + master/grad/slots at
+                    # 1/shards (stage 2)
+                    persistent += wb + rest * (2 + opt_slots)
+                    weight_bytes += wb + rest * (2 + opt_slots)
             else:
                 persistent += wb * (2 + opt_slots)
                 weight_bytes += wb * (2 + opt_slots)
@@ -116,6 +139,26 @@ def analyze(graph, mesh, *, opt_slots: int = 1, update_specs=None,
     peak_at = "(weights)"
     compute_nodes = [n for n in order if n.op_type not in _SKIP]
     total_act = 0.0
+    # stage-3 transient gather in flight per schedule position: the
+    # node's own gathered copies + the one-layer-ahead prefetch (fwd:
+    # the NEXT gathering node; bwd: the PREVIOUS one — the reverse walk
+    # prefetches in reverse). At most two gathered layers live at once.
+    g = [gather_of.get(n.guid, 0.0) for n in compute_nodes]
+    nxt_g = [0.0] * len(g)
+    run = 0.0
+    for t in range(len(g) - 1, -1, -1):
+        nxt_g[t] = run
+        if g[t] > 0:
+            run = g[t]
+    prv_g = [0.0] * len(g)
+    run = 0.0
+    for t in range(len(g)):
+        prv_g[t] = run
+        if g[t] > 0:
+            run = g[t]
+    fwd_inflight = [a + b for a, b in zip(g, nxt_g)]
+    bwd_inflight = [a + b for a, b in zip(g, prv_g)]
+    gather_peak = max(fwd_inflight + bwd_inflight, default=0.0)
     # inference: no backward retains anything — an activation dies after
     # its LAST consumer in the topo schedule
     last_use: dict[tuple[int, int], int] = {}
@@ -138,23 +181,27 @@ def analyze(graph, mesh, *, opt_slots: int = 1, update_specs=None,
             b = act_bytes_of.get((node.guid, i), 0.0)
             live += b
             total_act += b
+        here = live + fwd_inflight[t]
         timeline.append({"phase": "fwd", "op": node.name,
-                         "live_bytes": live})
-        if live > peak:
-            peak, peak_at = live, f"fwd:{node.name}"
+                         "live_bytes": here})
+        if here > peak:
+            peak, peak_at = here, f"fwd:{node.name}"
         if not training:
             for key in free_at.get(t, ()):
                 live -= act_bytes_of.get(key, 0.0)
     if training:
-        for node in reversed(compute_nodes):
-            # transient: the cotangent of this node's output(s) coexists
+        for t in range(len(compute_nodes) - 1, -1, -1):
+            node = compute_nodes[t]
+            # transient: the cotangent of this node's output(s) — and,
+            # under stage 3, its re-gathered weight copies — coexists
             # with the still-retained forward activations
             grad = sum(act_bytes_of.get((node.guid, i), 0.0)
                        for i in range(len(node.outputs)))
-            if live + grad > peak:
-                peak, peak_at = live + grad, f"bwd:{node.name}"
+            here = live + grad + bwd_inflight[t]
+            if here > peak:
+                peak, peak_at = here, f"bwd:{node.name}"
             timeline.append({"phase": "bwd", "op": node.name,
-                             "live_bytes": live + grad})
+                             "live_bytes": here})
             for i in range(len(node.outputs)):
                 live -= act_bytes_of.get((node.guid, i), 0.0)
     return {
@@ -163,6 +210,7 @@ def analyze(graph, mesh, *, opt_slots: int = 1, update_specs=None,
         "activation_bytes": total_act,
         "peak_bytes": peak,
         "peak_at": peak_at,
+        "gather_peak_bytes": gather_peak,
         "timeline": timeline[:_TIMELINE_CAP],
     }
 
@@ -172,6 +220,7 @@ def _cost_model_memory(graph, cost_model) -> float:
     assignments (the Σ op_cost memory the search/update-sharding decision
     consumed) — the number this pass cross-checks against."""
     mem = 0.0
+    gather_peak = 0.0
     for node in graph.topo_order():
         if node.op_type in _SKIP or node.is_parallel_op:
             continue
@@ -181,20 +230,26 @@ def _cost_model_memory(graph, cost_model) -> float:
             node, [_logical_assignment(pt) for pt in node.outputs],
             dict(node.weight_axes), in_shapes, in_assigns)
         mem += cmx.memory
-    return mem
+        gather_peak = max(gather_peak, cmx.gather_bytes)
+    # stage 3: the evaluators' two-gathered-layers-in-flight charge —
+    # the same rule, so the cross-check stays commensurable
+    return mem + 2.0 * gather_peak
 
 
 def run(graph, mesh, ctx=None) -> list[Finding]:
     opt_slots = getattr(ctx, "opt_slots", 1) if ctx is not None else 1
     update_specs = (getattr(ctx, "update_specs", None)
                     if ctx is not None else None)
+    update_stage = (getattr(ctx, "update_stage", 0)
+                    if ctx is not None else 0)
     training = getattr(ctx, "training", True) if ctx is not None else True
     cap = getattr(ctx, "hbm_cap_bytes", 0.0) if ctx is not None else 0.0
     cost_model = getattr(ctx, "cost_model", None) if ctx is not None \
         else None
 
     m = analyze(graph, mesh, opt_slots=opt_slots,
-                update_specs=update_specs, training=training)
+                update_specs=update_specs, training=training,
+                update_stage=update_stage)
     findings: list[Finding] = []
     top = sorted(m["timeline"], key=lambda t: -t["live_bytes"])[:8]
     details = {
@@ -203,6 +258,8 @@ def run(graph, mesh, ctx=None) -> list[Finding]:
         "persistent_bytes": m["persistent_bytes"],
         "weight_bytes": m["weight_bytes"],
         "activation_bytes": m["activation_bytes"],
+        "gather_peak_bytes": m.get("gather_peak_bytes", 0.0),
+        "update_stage": update_stage,
         "hbm_cap_bytes": cap,
         "top_live": top,
     }

@@ -74,16 +74,23 @@ class FFConfig:
     # ablation baseline (bench.py's seq-4096 kernel legs, PERF.md's
     # ~0.8 ms/step copies)
     flash_packed_layout: bool = True
-    # weight-update sharding (ZeRO / Xu et al. 2020): fp32 masters +
-    # optimizer slots sharded 1/dp along the gradient-reduction axes, the
-    # grad sync lowered as an overlappable reduce-scatter and the updated-
-    # param all-gather deferred into each consumer's first use next step.
-    # None (default) = Unity decides by pricing both updates — sharded is
-    # selected exactly when the plan is memory- or grad-sync-bound
-    # (search/unity.choose_update_sharding); True/False force it
-    # (--weight-update-sharding / --no-weight-update-sharding). Bit-
-    # identical trajectories either way (docs/performance.md).
+    # weight-update sharding (ZeRO / Xu et al. 2020; FSDP, Zhao et al.
+    # 2023): fp32 masters + optimizer slots sharded 1/dp along the
+    # gradient-reduction axes (stage 2), and — stage 3 — the trainable
+    # weights themselves sharded at rest with a just-in-time
+    # double-buffered ring all-gather per layer (issued one layer ahead
+    # on the overlappable channel, gathered copy dropped after last use,
+    # backward re-gathers). None (default) = Unity decides by pricing
+    # replicated vs stage 2 vs stage 3 — sharded is selected exactly
+    # when the plan is memory- or grad-sync-bound, and stage 3 exactly
+    # when stage 2's resident gathered copies are themselves over the
+    # HBM cap (search/unity.choose_update_sharding).
+    # `--weight-update-sharding[=stage3|stage2|off|on]` /
+    # `--no-weight-update-sharding` force it (weight_update_stage: None
+    # = auto among the enabled stages, 0/2/3 = forced). Bit-identical
+    # trajectories at every stage (docs/performance.md).
     weight_update_sharding: Optional[bool] = None
+    weight_update_stage: Optional[int] = None
     # parallelism gates (reference config.h:133-137)
     only_data_parallel: bool = False
     enable_sample_parallel: bool = False
@@ -319,10 +326,38 @@ class FFConfig:
                 self.search_overlap_backward_update = True
             elif a == "--no-overlap-collectives":
                 self.overlap_collectives = False
-            elif a == "--weight-update-sharding":
-                self.weight_update_sharding = True
+            elif a == "--weight-update-sharding" or a.startswith(
+                    "--weight-update-sharding="):
+                # value forms: --weight-update-sharding=stage3 (or a
+                # separate token); bare flag = legacy force-on with the
+                # stage decided by pricing (memory-bound -> 3, else 2)
+                if "=" in a:
+                    v = a.split("=", 1)[1]
+                elif (i + 1 < len(argv)
+                      and argv[i + 1] in ("stage2", "stage3", "off", "on",
+                                          "2", "3")):
+                    v = val()
+                else:
+                    v = "on"
+                if v in ("stage3", "3"):
+                    self.weight_update_sharding = True
+                    self.weight_update_stage = 3
+                elif v in ("stage2", "2"):
+                    self.weight_update_sharding = True
+                    self.weight_update_stage = 2
+                elif v == "off":
+                    self.weight_update_sharding = False
+                    self.weight_update_stage = 0
+                elif v == "on":
+                    self.weight_update_sharding = True
+                    self.weight_update_stage = None
+                else:
+                    raise ValueError(
+                        f"--weight-update-sharding={v!r}: expected "
+                        f"stage2|stage3|off|on")
             elif a == "--no-weight-update-sharding":
                 self.weight_update_sharding = False
+                self.weight_update_stage = 0
             elif a == "--flash-transposed":
                 self.flash_packed_layout = False
             elif a == "--fusion":

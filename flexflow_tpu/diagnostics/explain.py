@@ -241,9 +241,12 @@ def build_strategy_report(model) -> dict:
 
     # price the update mode that actually runs (unity.choose_update_
     # sharding's decision): sharded → the grad RS+AG rides the
-    # overlappable channel and memory carries the 1/dp state, so the
-    # drift monitor arms with the running schedule's makespan
+    # overlappable channel and memory carries the 1/dp state; stage 3
+    # additionally prices the just-in-time weight gathers and the
+    # 1/shards-at-rest weights — so the drift monitor arms with the
+    # running schedule's makespan
     us.cm.update_sharding = bool(upd.get("enabled"))
+    us.cm.param_gather = upd.get("stage", 0) == 3
     us.cm.overlap_update = (bool(upd.get("enabled"))
                             and bool(model.config.overlap_collectives))
 
@@ -273,6 +276,7 @@ def build_strategy_report(model) -> dict:
             "reshard_s": d["reshard_s"], "collective_s": d["collective_s"],
             "overlap_s": d.get("overlap_s", 0.0),
             "grad_sync_s": d.get("grad_sync_s", 0.0),
+            "param_gather_s": d.get("param_gather_s", 0.0),
             "sync_s": d["sync_s"],
             "comm_axis_id": d["comm_axis_id"],
             "memory_bytes": d["memory_bytes"],
@@ -288,15 +292,19 @@ def build_strategy_report(model) -> dict:
         "mesh_axes": {k: int(v) for k, v in
                       getattr(model.mesh, "shape", {}).items()},
         "overlap_sync": bool(us.config.search_overlap_backward_update),
-        # weight-update sharding (ZeRO / Xu et al.): whether the running
-        # plan shards masters + optimizer slots 1/dp, how many shards,
-        # and the grad RS+AG seconds priced on the overlappable channel
-        # (each op's share is its grad_sync_s, inside its overlap_s when
-        # overlapped — the makespan identity covers it via the same
+        # weight-update sharding (ZeRO / Xu et al.; FSDP stage 3): the
+        # running stage (0 replicated | 2 sharded optimizer | 3 params
+        # sharded at rest), how many shards, the grad RS+AG seconds
+        # priced on the overlappable channel, and — stage 3 — the
+        # just-in-time weight-gather seconds (each op's share is its
+        # grad_sync_s / param_gather_s, inside its overlap_s when
+        # overlapped — the makespan identity covers both via the same
         # per-axis occupancy bound as the ring traffic)
         "update_sharding": bool(upd.get("enabled")),
+        "update_stage": int(upd.get("stage", 0)),
         "update_shards": int(upd.get("shards", 1)),
         "grad_sync_s": 0.0,  # filled from the op entries below
+        "param_gather_s": 0.0,
         "total_predicted_s": makespan,
         "penalized_cost_s": chosen_cost,
         "peak_memory_bytes": mem,
@@ -309,6 +317,8 @@ def build_strategy_report(model) -> dict:
         "runner_up_evals": flip_evals,
     }
     report["grad_sync_s"] = float(sum(o["grad_sync_s"] for o in ops))
+    report["param_gather_s"] = float(
+        sum(o["param_gather_s"] for o in ops))
     analysis = getattr(model, "_analysis", None)
     if analysis is not None:
         # ffcheck results (analysis/): the compile gate's findings ride
@@ -350,11 +360,21 @@ def render_markdown(report: dict) -> str:
         f"{'ON' if report.get('sanitize_numerics') else 'off'}"
         f"  ·  SPMD barrier: {report.get('spmd_barrier', 'off')}")
     if report.get("update_sharding"):
+        stage = report.get("update_stage", 2)
         lines.append(
-            f"- weight-update sharding: ON — masters + optimizer slots "
-            f"1/{report.get('update_shards', 1)} per chip, grad RS+AG "
-            f"{report.get('grad_sync_s', 0.0) * 1e3:.3f} ms on the "
+            f"- weight-update sharding: stage {stage} — masters + "
+            f"optimizer slots"
+            + (" + weights-at-rest" if stage == 3 else "")
+            + f" 1/{report.get('update_shards', 1)} per chip, grad RS"
+            + ("" if stage == 3 else "+AG")
+            + f" {report.get('grad_sync_s', 0.0) * 1e3:.3f} ms on the "
             f"overlappable channel")
+        if stage == 3:
+            lines.append(
+                f"- param gather (ZeRO-3/FSDP): just-in-time per-layer "
+                f"ring all-gather, "
+                f"{report.get('param_gather_s', 0.0) * 1e3:.3f} ms "
+                f"issued one layer ahead (fwd + bwd re-gather)")
     lines += [
         "",
         "## Per-op attribution",

@@ -25,6 +25,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .config import FFConfig
@@ -40,6 +41,19 @@ from .pcg.graph import Graph, OpNode
 def _stable_fold(key, name: str):
     h = int.from_bytes(hashlib.md5(name.encode()).digest()[:4], "little")
     return jax.random.fold_in(key, h)
+
+
+# stage-3 (ZeRO-3 / FSDP) residual policy: the jax.checkpoint regions in
+# _forward_gathered save every intermediate EXCEPT the gathered weight
+# copies tagged with this name — so the backward re-gathers them instead
+# of keeping a full per-layer copy live across the whole fwd+bwd, and
+# nothing else is recomputed. Older jax without named policies degrades
+# to full-region remat (still bit-identical, just recomputes the op).
+_GATHER_NAME = "fsdp_gather"
+_FSDP_SAVE_POLICY = (
+    jax.checkpoint_policies.save_anything_except_these_names(_GATHER_NAME)
+    if hasattr(jax.checkpoint_policies, "save_anything_except_these_names")
+    else None)
 
 
 class Executor:
@@ -78,6 +92,28 @@ class Executor:
         # replicated update.
         self.update_sharding = update_sharding or {"enabled": False}
         self.update_specs: dict[tuple[str, str], tuple] = {}
+        # ZeRO-3 / FSDP stage 3 (choose_update_sharding stage == 3): the
+        # trainable weights themselves live sharded at rest in the SAME
+        # update_specs layout, and _apply gathers each layer's params
+        # just-in-time with a double-buffered ring all-gather
+        # (parallel/ops.ring_all_gather) issued one layer ahead on the
+        # overlappable channel, the gathered copy dropped after last use
+        # (the backward re-gathers under jax.checkpoint). gather_specs
+        # holds, per sharded weight, what the gather needs: the compute
+        # placement it restores, the update axes it unwinds, and the dim
+        # they shard. gather_schedule is the per-layer prefetch schedule
+        # derived from the PCG topological order: entry k's gather is
+        # issued behind entry k-1's compute (XLA's latency-hiding
+        # scheduler realizes the overlap from the ring hops'
+        # data-independence).
+        self.update_stage = int(self.update_sharding.get(
+            "stage", 2 if self.update_sharding.get("enabled") else 0))
+        self.gather_specs: dict[tuple[str, str], tuple] = {}
+        self.gather_schedule: list[tuple[str, Optional[str]]] = []
+        # custom-VJP gather callables keyed by (owner, wname); built once
+        # per weight at first trace (the overlap flag is read inside
+        # _gather_param at trace time — config is fixed for the compile)
+        self._gather_fns: dict[tuple[str, str], Any] = {}
         if self.update_sharding.get("enabled"):
             self._build_update_specs()
         # A substitution rewrite may have interposed Combine/Repartition/...
@@ -143,7 +179,10 @@ class Executor:
         grad_sync bytes counter per layer-order bucket (= param-owning
         node) so the drift monitor sees the new comm channel."""
         from . import telemetry
-        from .parallel.ops import grad_sync_axes, weight_update_spec
+        from .parallel.ops import (
+            _spec_assignment, choose_update_dim, grad_sync_axes,
+            weight_update_spec,
+        )
 
         axis_sizes = {k: int(v) for k, v in dict(self.mesh.shape).items()}
         total_bytes = 0
@@ -180,6 +219,15 @@ class Executor:
                     continue
                 self.update_specs[(node.name, ws.name)] = (
                     spec, tuple(ws.shape))
+                if self.update_stage >= 3:
+                    # stage 3: record what the just-in-time gather needs
+                    # — the compute placement it restores (base), the
+                    # update axes it unwinds, and the dim they shard
+                    dim = choose_update_dim(
+                        ws.shape, _spec_assignment(base, len(ws.shape)),
+                        axes, axis_sizes)
+                    self.gather_specs[(node.name, ws.name)] = (
+                        base, spec, tuple(axes), dim)
                 used_axes.update(axes)
                 deg = 1
                 for ax in axes:
@@ -196,6 +244,33 @@ class Executor:
                                     buckets=buckets,
                                     sharded_weights=len(self.update_specs),
                                     bytes=total_bytes)
+        if self.gather_specs:
+            # one-layer-ahead prefetch schedule from the PCG topological
+            # order: entry k's fwd gather is issued behind entry k-1's
+            # compute (None = the first gather, nothing to hide behind);
+            # the backward walks it in reverse. The ring hops carry no
+            # data dependence on the neighbouring compute, which is what
+            # lets the latency-hiding scheduler realize this schedule.
+            owners = []
+            for node in self.order:
+                if getattr(node, "weight_source", None):
+                    continue
+                if any((node.name, ws.name) in self.gather_specs
+                       for ws in node.weight_specs):
+                    owners.append(node.name)
+            self.gather_schedule = [
+                (name, owners[i - 1] if i > 0 else None)
+                for i, name in enumerate(owners)]
+            gathered_bytes = sum(
+                int(np.prod(shape)) * 4
+                for key, (_spec, shape) in self.update_specs.items()
+                if key in self.gather_specs)
+            telemetry.event(
+                "param_gather",
+                layers=len(owners),
+                sharded_weights=len(self.gather_specs),
+                bytes=gathered_bytes,
+                overlap=bool(self.config.overlap_collectives))
         if self.update_specs:
             # the REALIZED layout can exceed the decision's dp-default
             # guess (a seq-sharded consumer adds `seq` to a weight's
@@ -208,12 +283,15 @@ class Executor:
             # dim: nothing runs sharded, so the record — and everything
             # downstream that prices or audits it — must say replicated
             self.update_sharding.update(
-                enabled=False, shards=1, axes=[],
+                enabled=False, stage=0, shards=1, axes=[],
                 reason=self.update_sharding.get("reason", "")
                 + "+no_shardable_weight")
+            self.update_stage = 0
+            self.gather_specs.clear()
         if self.update_specs:
             telemetry.event(
                 "weight_update",
+                stage=self.update_stage,
                 shards=int(self.update_sharding.get("shards", 1)),
                 buckets=buckets, sharded_weights=len(self.update_specs),
                 bytes=total_bytes)
@@ -253,6 +331,110 @@ class Executor:
         insurance that params/slots restored or constructed elsewhere land
         at rest in the sharded layout."""
         return self._map_update_leaves(tree, jax.device_put)
+
+    # -------------------------------------------------- stage-3 gathers
+
+    def _gather_param(self, owner: str, wname: str, arr):
+        """Ring all-gather one stage-3 weight from its at-rest update
+        layout back to its compute placement — exact data movement, so
+        the gathered value is bit-identical to a replicated weight.
+        Multi-axis updates unwind one ring per axis, minor axis first
+        (weight_update_spec appends the update axes onto the dim, so
+        chunks concatenate in ring order within each outer shard). Hops
+        are double-buffered (hop-before-use) when overlap_collectives is
+        on; --no-overlap-collectives is the serial hop-then-write
+        ablation — bit-identical either way."""
+        from .parallel.ops import _spec_assignment, ring_all_gather
+
+        base, upd, axes, dim = self.gather_specs[(owner, wname)]
+        overlap = bool(self.config.overlap_collectives)
+        cur = list(_spec_assignment(upd, arr.ndim))
+
+        def to_spec(assignment):
+            return PartitionSpec(*(
+                None if not e else (e[0] if len(e) == 1 else tuple(e))
+                for e in assignment))
+
+        with jax.named_scope(f"param_gather/{owner}.{wname}"):
+            for ax in reversed(axes):
+                nxt = list(cur)
+                entry = list(nxt[dim])
+                entry.remove(ax)
+                nxt[dim] = tuple(entry)
+                arr = ring_all_gather(
+                    arr, mesh=self.mesh, axis_name=ax, dim=dim,
+                    overlap=overlap,
+                    in_spec=to_spec(cur), out_spec=to_spec(nxt))
+                cur = nxt
+        return arr
+
+    def _gather_with_vjp(self, owner: str, wname: str):
+        """The stage-3 gather as a custom-VJP callable (built once per
+        weight): forward = the explicit ring all-gather; backward = the
+        gathered copy's cotangent pinned to the compute placement
+        (replicated over the update axes) — the exact stage-2 gradient
+        path, so GSPMD lowers the dp psum into the same reduce-scatter
+        and the trajectory stays bit-identical to the replicated
+        baseline; _pin_update_sharding then slices the owner's shard.
+        (Autodiff THROUGH the ring would accumulate the grad chunks in
+        ring-arrival order, which is NOT the allreduce's ULP order —
+        measured as ~1e-7 drift on the CI mesh.)"""
+        key = (owner, wname)
+        fn = self._gather_fns.get(key)
+        if fn is not None:
+            return fn
+        base = self.gather_specs[key][0]
+        base_sh = NamedSharding(
+            self.mesh, base if base is not None else PartitionSpec())
+
+        @jax.custom_vjp
+        def gather(w):
+            return self._gather_param(owner, wname, w)
+
+        def fwd(w):
+            return gather(w), None
+
+        def bwd(_, ct):
+            return (jax.lax.with_sharding_constraint(ct, base_sh),)
+
+        gather.defvjp(fwd, bwd)
+        self._gather_fns[key] = gather
+        return gather
+
+    def _forward_gathered(self, node, wsrc, gathered, p_own, new_state,
+                          ins, op_state, ctx):
+        """Stage-3 forward of one op: gather its sharded-at-rest weights
+        just-in-time inside a jax.checkpoint region whose policy refuses
+        to save the gathered copies — they are DROPPED after the op's
+        last use and the backward re-gathers them (ZeRO-3; the ASPLOS'23
+        decomposition pattern applied to the forward). Everything else
+        the VJP needs (the op's inputs, its saveable internals) is
+        stored as usual, so the only recompute is the re-gather itself.
+        The compute-dtype cast sits inside the region too, so it fuses
+        with the gather exactly as it fused with the implicit stage-2
+        all-gather."""
+        shard_p = {k: p_own[k] for k in gathered}
+        plain_p = {k: v for k, v in p_own.items() if k not in gathered}
+        state_w = new_state.get(wsrc, {})
+
+        def run(shard_p, plain_p, ins_t, op_state_in, state_w):
+            full = {
+                k: checkpoint_name(self._gather_with_vjp(wsrc, k)(v),
+                                   _GATHER_NAME)
+                for k, v in shard_p.items()}
+            weights = {}
+            weights.update(self._cast_compute({**plain_p, **full}))
+            weights.update(state_w)
+            return node.op_def.forward(node.params, list(ins_t), weights,
+                                       op_state_in, ctx)
+
+        # prevent_cse=False: these regions only ever run inside jit
+        # (the documented-safe case), and the CSE barriers would pin the
+        # ring hops behind region boundaries — defeating the one-ahead
+        # overlap the schedule exists for
+        remat = jax.checkpoint(run, policy=_FSDP_SAVE_POLICY,
+                               prevent_cse=False)
+        return remat(shard_p, plain_p, tuple(ins), op_state, state_w)
 
     def _cast_compute(self, tree):
         """Cast float leaves to the compute dtype (inside jit; the VJP of the
@@ -385,8 +567,11 @@ class Executor:
                 upd = self.update_specs.get((node.name, ws.name))
                 if upd is not None:
                     # at-rest layout under weight-update sharding: the
-                    # fp32 master lives 1/dp-sharded; consumers all-gather
-                    # at first use (fused with their compute-dtype cast)
+                    # fp32 master lives 1/dp-sharded. Stage 2: consumers
+                    # all-gather at first use (GSPMD, fused with their
+                    # compute-dtype cast). Stage 3: _apply gathers
+                    # just-in-time with the explicit ring all-gather and
+                    # drops the copy after last use.
                     spec = upd[0]
                 arr = jax.device_put(arr, NamedSharding(self.mesh, spec))
                 (p if ws.trainable else s)[ws.name] = arr
@@ -431,14 +616,13 @@ class Executor:
             # tied weights read the source node's parameter set; autodiff
             # then sums every use's gradient into that one set
             wsrc = getattr(node, "weight_source", None) or node.name
-            weights = {}
-            # bf16 cast at the consumer: each node casts only its own
-            # weights, so XLA fuses the downcast into the first use
-            # instead of writing a model-sized bf16 copy to HBM up front
-            # (state stays uncast — ops own their fp32-statistics
-            # handling)
-            weights.update(self._cast_compute(params.get(wsrc, {})))
-            weights.update(new_state.get(wsrc, {}))
+            p_own = params.get(wsrc, {})
+            # stage 3 (ZeRO-3/FSDP): this node's sharded-at-rest weights
+            # are ring-gathered just-in-time inside a remat region that
+            # drops the gathered copies after last use (bwd re-gathers)
+            gathered = ([k for k in p_own
+                         if (wsrc, k) in self.gather_specs]
+                        if self.update_stage >= 3 else [])
             ctx = OpContext(
                 training=training,
                 rng=_stable_fold(rng, node.name) if rng is not None else None,
@@ -453,9 +637,22 @@ class Executor:
             # named_scope labels the op in XLA profiles (the analog of the
             # reference's per-op profiling prints, linear_kernels.cu:95-117)
             with jax.named_scope(node.name):
-                outs, op_state = node.op_def.forward(
-                    node.params, ins, weights, op_state, ctx
-                )
+                if gathered:
+                    outs, op_state = self._forward_gathered(
+                        node, wsrc, gathered, p_own, new_state, ins,
+                        op_state, ctx)
+                else:
+                    weights = {}
+                    # bf16 cast at the consumer: each node casts only its
+                    # own weights, so XLA fuses the downcast into the
+                    # first use instead of writing a model-sized bf16
+                    # copy to HBM up front (state stays uncast — ops own
+                    # their fp32-statistics handling)
+                    weights.update(self._cast_compute(p_own))
+                    weights.update(new_state.get(wsrc, {}))
+                    outs, op_state = node.op_def.forward(
+                        node.params, ins, weights, op_state, ctx
+                    )
             if op_state:
                 op_state = dict(op_state)
                 aux = op_state.pop("aux_loss", None)
@@ -649,6 +846,31 @@ class Executor:
         self._copy_fn = jax.jit(
             copy_blocks, donate_argnums=_donate_argnums((0,)))
         return self._copy_fn
+
+    def build_param_gather(self):
+        """The stage-3 params' full gather as ONE donated executable:
+        every sharded-at-rest leaf ring-gathered back to its compute
+        placement (replicated over the update axes) in a single
+        dispatch; non-stage-3 leaves pass through. Consume-point
+        semantics: the input tree is donated, so callers REBIND
+        (`tree = gather_fn(tree)`) — the carry pattern the donated-reuse
+        lint enforces. Used by the bench's param-sharding legs and the
+        fsdp smoke to read/verify the gathered model without one host
+        round-trip per weight; a no-op identity dispatch below stage 3."""
+
+        def gather_params(params):
+            out = {}
+            for name, ws in params.items():
+                nw = dict(ws)
+                for k in ws:
+                    if (name, k) in self.gather_specs:
+                        nw[k] = self._gather_param(name, k, ws[k])
+                out[name] = nw
+            return out
+
+        self._gather_fn = jax.jit(
+            gather_params, donate_argnums=_donate_argnums((0,)))
+        return self._gather_fn
 
     def build_forward(self):
         def forward(params, state, x_inputs, training):

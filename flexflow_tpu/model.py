@@ -890,10 +890,14 @@ class FFModel:
                 # forced sharded update: the placement search itself must
                 # price sync as the overlappable RS+AG + 1/dp state (auto
                 # mode decides after the placements are materialized —
-                # choose_update_sharding below). Inference compiles — a
-                # serving replay inherits the trainer's config — have no
-                # grad sync or optimizer state to price.
+                # choose_update_sharding below); a forced stage 3 also
+                # prices weights 1/shards-at-rest + the just-in-time
+                # gather pair. Inference compiles — a serving replay
+                # inherits the trainer's config — have no grad sync or
+                # optimizer state to price.
                 cost_model.update_sharding = True
+                cost_model.param_gather = (
+                    self.config.weight_update_stage == 3)
                 cost_model.overlap_update = bool(
                     self.config.overlap_collectives)
             search_cost_model = cost_model
@@ -1198,6 +1202,8 @@ class FFModel:
                 # strategy report / drift monitor must describe what runs)
                 search_cost_model.update_sharding = (
                     self._update_sharding["enabled"])
+                search_cost_model.param_gather = (
+                    self._update_sharding.get("stage", 0) == 3)
                 search_cost_model.overlap_update = (
                     self._update_sharding["enabled"]
                     and bool(self.config.overlap_collectives))
@@ -1215,6 +1221,7 @@ class FFModel:
         telemetry.event(
             "weight_update_decision",
             enabled=self._update_sharding["enabled"],
+            stage=self._update_sharding.get("stage", 0),
             shards=self._update_sharding["shards"],
             reason=self._update_sharding.get("reason", ""))
         # --- ffcheck compile gate (analysis/): static verification of the

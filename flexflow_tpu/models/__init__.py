@@ -12,11 +12,14 @@ from .mlp import build_mlp_unify, build_mnist_mlp
 from .moe import MoeConfig, build_moe
 from .resnet import build_resnet50, build_resnext50
 from .transformer import (
+    TRANSFORMER_LM_ZOO,
     TransformerConfig,
     TransformerLMConfig,
     build_transformer,
     build_transformer_lm,
     build_transformer_lm_decode,
     build_transformer_lm_pipelined,
+    transformer_lm_param_count,
+    transformer_lm_state_bytes_per_chip,
 )
 from .xdl import build_xdl

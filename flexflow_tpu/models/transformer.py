@@ -202,6 +202,67 @@ def build_transformer_lm_pipelined(ff, config: TransformerLMConfig | None = None
     return tokens, logits
 
 
+def transformer_lm_param_count(c: TransformerLMConfig) -> int:
+    """Trainable parameter count of the flagship LM (embeddings + blocks
+    + final norm + head) — the zoo sizing / FSDP-capacity arithmetic."""
+    d, L, v = c.hidden_size, c.num_layers, c.vocab_size
+    per_layer = (4 * d * d + 4 * d          # attention qkv+o (+ biases)
+                 + 2 * c.mlp_ratio * d * d  # mlp up + down
+                 + c.mlp_ratio * d + d      # mlp biases
+                 + 4 * d)                   # 2× layernorm scale+bias
+    return (v * d + c.sequence_length * d   # wte + wpe
+            + L * per_layer
+            + 2 * d                         # final norm
+            + v * d)                        # lm_head
+
+
+def transformer_lm_state_bytes_per_chip(c: TransformerLMConfig,
+                                        opt_slots: int = 2,
+                                        update_stage: int = 0,
+                                        shards: int = 1) -> float:
+    """Resident fp32 training-state bytes per chip — master + grad +
+    `opt_slots` optimizer entries per parameter — under a given
+    weight-update stage. Stage 2 shards masters/grads/slots 1/shards but
+    keeps one gathered compute copy resident per weight; stage 3
+    (ZeRO-3/FSDP) shards the weights at rest too, so per-chip model
+    state shrinks ~1/shards and the zoo grows past what one chip can
+    hold replicated."""
+    n = float(transformer_lm_param_count(c)) * 4.0
+    state = n * (2 + opt_slots)
+    if update_stage >= 3 and shards > 1:
+        return state / shards
+    if update_stage >= 2 and shards > 1:
+        return n + state / shards
+    return state
+
+
+# The model zoo bench.py / the smokes draw from, ordered by scale. The
+# `-fsdp` tiers are sized so their REPLICATED training state (masters +
+# grads + Adam slots ≈ 16 bytes/param) exceeds a single chip of the
+# named HBM class while the 1/shards stage-3 layout fits — the ZeRO-3
+# enabler for growing the zoo past one replicated chip (ROADMAP item 5).
+TRANSFORMER_LM_ZOO: dict = {
+    # CPU-smoke scale: tiny, runs everywhere
+    "lm-smoke": TransformerLMConfig(
+        vocab_size=512, hidden_size=128, num_heads=4, num_layers=2,
+        sequence_length=128, attention_impl="xla"),
+    # the reference benchmark scale (transformer.cc:79-85)
+    "lm-base": TransformerLMConfig(
+        vocab_size=32000, hidden_size=1024, num_heads=16, num_layers=12,
+        sequence_length=512),
+    # ~1.3B params: replicated Adam state ≈ 21 GB — over one 16 GB chip,
+    # under it at 1/4 stage-3 shards
+    "lm-xl-fsdp": TransformerLMConfig(
+        vocab_size=32000, hidden_size=2048, num_heads=32, num_layers=24,
+        sequence_length=1024),
+    # ~6.7B params: replicated Adam state ≈ 107 GB — needs stage 3 even
+    # on 95 GB-class chips once activations are counted
+    "lm-xxl-fsdp": TransformerLMConfig(
+        vocab_size=32000, hidden_size=4096, num_heads=32, num_layers=32,
+        sequence_length=2048),
+}
+
+
 def transformer_lm_flops_per_token(c: TransformerLMConfig) -> float:
     """Analytic fwd+bwd FLOPs/token for MFU accounting (6N_matmul + attn).
     The wte/wpe lookups are gathers (no matmul FLOPs); only the lm_head's

@@ -60,6 +60,12 @@ class ResilienceManager:
             # direction and across dp degrees
             extras["update_sharding"] = {
                 "enabled": bool(upd.get("enabled")),
+                # the running ZeRO stage (0 replicated | 2 sharded
+                # optimizer | 3 params sharded at rest): elastic resume
+                # re-places the full logical arrays under the RESTORING
+                # compile's stage, so toggles across saves are safe —
+                # the record is for post-mortems and audits
+                "stage": int(upd.get("stage", 0)),
                 "shards": int(upd.get("shards", 1)),
                 "axes": list(upd.get("axes", [])),
             }

@@ -8,6 +8,16 @@ construction: restoring onto a different searched Strategy is a
 NamedSharding — GSPMD then owns slicing it onto the new mesh (e.g. save
 under dp=8, resume under dp=4×tp=2). This is the reshard-aware recovery
 path of Gemini (SOSP'23) recast onto JAX shardings.
+
+The same contract carries elastic resume across weight-update-sharding
+stages (off ↔ stage 2 ↔ stage 3 / ZeRO-3): a stage-3 compile's param
+templates carry the at-rest `update_specs` NamedSharding, so
+`place_like` re-places the full logical array 1/shards-sharded — and a
+replicated compile restoring a stage-3 run's checkpoint re-places the
+same logical values replicated. No stage-specific code here, by
+design; the manifest's `extras.update_sharding.stage` records how the
+WRITER ran (tests: kill→resume across stage toggles in
+tests/test_weight_update.py).
 """
 
 from __future__ import annotations

@@ -60,11 +60,22 @@ class CostMetrics:
     update_hops: float = 0.0
     update_hop_s: float = 0.0
     update_shards: int = 1
+    # ZeRO-3 / FSDP (stage 3, param_gather): the just-in-time all-gather
+    # of this node's sharded-at-rest weights — one AG on the forward, one
+    # re-gather on the backward (the gathered copy is dropped after last
+    # use) — plus its summed per-hop issue latency, and the FULL gathered
+    # bytes of the node's stage-3 weights (the evaluators charge at most
+    # two gathered layers in flight, not one per weight). All zero below
+    # stage 3.
+    param_gather_time: float = 0.0
+    param_gather_hop_s: float = 0.0
+    gather_bytes: float = 0.0
 
     @property
     def total(self) -> float:
         return (self.forward_time + self.backward_time + self.sync_time
-                + self.update_sync_time + self.comm_time)
+                + self.update_sync_time + self.param_gather_time
+                + self.comm_time)
 
 
 def price_grad_sync(cm: "CostMetrics", update_sharding: bool,
@@ -93,6 +104,28 @@ def price_grad_sync(cm: "CostMetrics", update_sharding: bool,
     if overlap_update:
         return cm.sync_time, pair, cm.update_hop_s, pair
     return cm.sync_time + pair + cm.update_hop_s, 0.0, 0.0, pair
+
+
+def price_param_gather(cm: "CostMetrics", overlap_update: bool
+                       ) -> tuple[float, float, float, float]:
+    """(serial_s, overlappable_comm_s, overlap_overhead_s, param_gather_s)
+    of one node's stage-3 just-in-time weight gathers — the
+    `price_grad_sync` sibling, applied by BOTH evaluators so the stage-3
+    decision can never disagree with the reported makespan. The fwd
+    gather is issued one layer ahead on the overlappable channel (it
+    hides behind the previous layer's compute) and the bwd re-gather
+    behind the next layer's backward; only the fixed per-hop issue
+    latency never hides. Under --no-overlap-collectives the pair
+    serializes on the node's critical path — so serial stage 3 prices
+    strictly above stage 2 (the auto decision's tie-breaker).
+    param_gather_time is only populated when the cost model prices
+    stage 3 (CostModel.param_gather), so no flag argument is needed."""
+    pg = cm.param_gather_time
+    if pg <= 0.0:
+        return 0.0, 0.0, 0.0, 0.0
+    if overlap_update:
+        return 0.0, pg, cm.param_gather_hop_s, pg
+    return pg + cm.param_gather_hop_s, 0.0, 0.0, pg
 
 
 def _shard_elems(shape: tuple[int, ...], assignment, axis_sizes) -> float:
@@ -384,6 +417,14 @@ class CostModel:
         # unity.choose_update_sharding / --weight-update-sharding.
         self.update_sharding = False
         self.overlap_update = False
+        # ZeRO-3 / FSDP (stage 3): additionally price the trainable
+        # weights SHARDED AT REST — per-chip memory drops the always-live
+        # gathered compute copy (the evaluators charge at most two
+        # gathered layers in flight instead), the grad sync becomes the
+        # RS alone, and the fwd gather + bwd re-gather pair is priced by
+        # price_param_gather on the overlappable channel. Implies
+        # update_sharding.
+        self.param_gather = False
         self._cache: dict = {}
         self._calibration: dict = {}
 
@@ -396,7 +437,7 @@ class CostModel:
                tuple(sorted((k, str(v)) for k, v in
                             (weight_specs_assigns or {}).items())),
                tuple(tuple(tuple(e) for e in (a or ())) for a in in_assigns),
-               self.update_sharding)
+               self.update_sharding, self.param_gather)
         if key in self._cache:
             return self._cache[key]
 
@@ -452,6 +493,9 @@ class CostModel:
         update_hops = 0.0
         update_hop_s = 0.0
         update_shards = 1
+        param_gather_t = 0.0
+        param_gather_hop_s = 0.0
+        gather_bytes = 0.0
         for ws in node.weight_specs:
             spec = (weight_specs_assigns or {}).get(ws.name)
             w_assign = _spec_to_assignment(spec, len(ws.shape))
@@ -482,16 +526,40 @@ class CostModel:
                     # and keeps pricing serial, matching the runtime) +
                     # the 1/dp state below. Hop issue latency priced at
                     # the axis's own latency (DCN hops cost ~10× ICI)
-                    update_sync += (self.machine.reduce_scatter(wb, ax)
-                                    + self.machine.all_gather(wb, ax))
+                    rs_t = self.machine.reduce_scatter(wb, ax)
+                    ag_t = self.machine.all_gather(wb, ax)
                     n = self.machine.axis_size(ax)
-                    update_hops += 2.0 * (n - 1)
-                    update_hop_s += 2.0 * (n - 1) * self.machine._lat(ax)
+                    lat = (n - 1) * self.machine._lat(ax)
+                    if self.param_gather:
+                        # stage 3: the grad sync is the RS alone (the
+                        # cotangent of the gathered copy scatters to the
+                        # owner shard); the deferred AG moves into the
+                        # explicit gather pair — fwd just-in-time + bwd
+                        # re-gather — priced by price_param_gather
+                        update_sync += rs_t
+                        update_hops += n - 1
+                        update_hop_s += lat
+                        param_gather_t += 2.0 * ag_t
+                        param_gather_hop_s += 2.0 * lat
+                    else:
+                        update_sync += rs_t + ag_t
+                        update_hops += 2.0 * (n - 1)
+                        update_hop_s += 2.0 * lat
                     shards *= n
                 update_shards = max(update_shards, shards)
-                # per-chip memory: one gathered compute copy + master/
-                # grad/slots sharded 1/shards (the ZeRO saving)
-                weight_mem += wb + wb * (2 + self.opt_slots) / shards
+                if self.param_gather:
+                    # stage 3 per-chip memory: master/grad/slots sharded
+                    # 1/shards with NO resident gathered copy — the
+                    # transient two-layers-in-flight gather working set
+                    # is charged once per plan by the evaluators
+                    # (gather_bytes below), not once per weight
+                    weight_mem += wb * (2 + self.opt_slots) / shards
+                    gather_bytes += wb
+                else:
+                    # per-chip memory: one gathered compute copy +
+                    # master/grad/slots sharded 1/shards (the ZeRO
+                    # stage-2 saving)
+                    weight_mem += wb + wb * (2 + self.opt_slots) / shards
             else:
                 for ax in sync_axes:
                     sync += self.machine.all_reduce(wb, ax)
@@ -528,6 +596,9 @@ class CostModel:
             update_hops=update_hops,
             update_hop_s=update_hop_s,
             update_shards=update_shards,
+            param_gather_time=param_gather_t,
+            param_gather_hop_s=param_gather_hop_s,
+            gather_bytes=gather_bytes,
         )
         self._cache[key] = cm
         return cm

@@ -714,13 +714,20 @@ def evaluate_assigned_graph(graph: Graph, mesh, cm: CostModel,
     is the task-graph makespan. When the cost model prices a ZeRO-sharded
     update (cm.update_sharding + cm.overlap_update), the grad RS+AG rides
     the overlappable channel — max(compute, comm) + hop latency — exactly
-    as UnitySearch.evaluate prices it. `totals`, when a dict, additionally
+    as UnitySearch.evaluate prices it; under stage 3 (cm.param_gather)
+    the just-in-time weight-gather pair joins it via price_param_gather
+    and the per-chip memory charges weights at 1/shards plus at most two
+    gathered layers in flight. `totals`, when a dict, additionally
     accumulates the summed grad-sync seconds under "sync_s" (the
-    update-sharding decision reads the sync fraction off it)."""
-    from .cost_model import _MakespanAccum, price_grad_sync
+    update-sharding decision reads the sync fraction off it) and the
+    summed gather seconds under "param_gather_s"."""
+    from .cost_model import (
+        _MakespanAccum, price_grad_sync, price_param_gather,
+    )
 
     acc = _MakespanAccum(overlap_sync=overlap_sync)
     mem = 0.0
+    gather_peak = 0.0
     machine = cm.machine
     for node in graph.topo_order():
         if node.op_type in (OT.OP_INPUT, OT.OP_WEIGHT, OT.OP_NOOP):
@@ -739,17 +746,25 @@ def evaluate_assigned_graph(graph: Graph, mesh, cm: CostModel,
         grad_sync = cmx.sync_time + cmx.update_sync_time
         if totals is not None:
             totals["sync_s"] = totals.get("sync_s", 0.0) + grad_sync
-        # the shared update-mode pricing rule (cost_model.price_grad_sync
-        # — the same rule UnitySearch.evaluate applies, so the decision
-        # made through here matches the reported makespan)
+            totals["param_gather_s"] = (totals.get("param_gather_s", 0.0)
+                                        + cmx.param_gather_time)
+        # the shared update-mode pricing rules (cost_model.price_grad_sync
+        # / price_param_gather — the same rules UnitySearch.evaluate
+        # applies, so the decision made through here matches the reported
+        # makespan)
         sync, overlap_comm, overlap_overhead, _ = price_grad_sync(
             cmx, cm.update_sharding, getattr(cm, "overlap_update", False))
+        pg_serial, pg_overlap, pg_overhead, _ = price_param_gather(
+            cmx, getattr(cm, "overlap_update", False))
         acc.add(node.guid, cmx.forward_time + cmx.backward_time,
-                cmx.comm_time, sync=sync,
-                comm_axes=(AXIS_DATA,) if grad_sync > 0 else (),
-                overlappable_comm=overlap_comm,
-                overlap_overhead=overlap_overhead)
+                cmx.comm_time + pg_serial, sync=sync,
+                comm_axes=(AXIS_DATA,)
+                if grad_sync > 0 or cmx.param_gather_time > 0 else (),
+                overlappable_comm=overlap_comm + pg_overlap,
+                overlap_overhead=overlap_overhead + pg_overhead)
         mem += cmx.memory
+        gather_peak = max(gather_peak, cmx.gather_bytes)
+    mem += 2.0 * gather_peak
     return acc.makespan(graph.in_edges), mem
 
 

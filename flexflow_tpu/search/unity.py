@@ -45,6 +45,7 @@ from .cost_model import (
     classify_reshard,
     dtype_bytes,
     price_grad_sync,
+    price_param_gather,
     price_parallel_node,
 )
 from .machine_model import TPUMachineModel
@@ -348,6 +349,10 @@ class UnitySearch:
         acc = _MakespanAccum(
             overlap_sync=self.config.search_overlap_backward_update)
         mem = 0.0
+        # stage-3 transient gather working set: at most two gathered
+        # layers in flight (the current layer + the one-ahead prefetch),
+        # charged once per plan at the LARGEST node's gathered bytes
+        gather_peak = 0.0
         for node in self.order:
             if node.op_type in (OT.OP_INPUT, OT.OP_WEIGHT, OT.OP_NOOP):
                 continue
@@ -452,14 +457,17 @@ class UnitySearch:
                 else:
                     psum += ring_comm
             grad_sync = cm.sync_time + cm.update_sync_time
-            # the shared update-mode pricing rule (cost_model.
-            # price_grad_sync — also what choose_update_sharding decides
-            # through, via evaluate_assigned_graph)
+            # the shared update-mode pricing rules (cost_model.
+            # price_grad_sync / price_param_gather — also what
+            # choose_update_sharding decides through, via
+            # evaluate_assigned_graph)
             sync_arg, gs_overlap, gs_overhead, grad_sync_sharded = (
                 price_grad_sync(cm, self.cm.update_sharding,
                                 self.cm.overlap_update))
-            overlap_comm += gs_overlap
-            overlap_overhead += gs_overhead
+            pg_serial, pg_overlap, pg_overhead, param_gather_s = (
+                price_param_gather(cm, self.cm.overlap_update))
+            overlap_comm += gs_overlap + pg_overlap
+            overlap_overhead += gs_overhead + pg_overhead
             compute_t = cm.forward_time + cm.backward_time
             if (cfg.name == "pp"
                     and node.op_type == OT.OP_PIPE_BLOCKS):
@@ -487,15 +495,17 @@ class UnitySearch:
                 psum += 3.0 * (M + P - 1) * self.cm.machine.ppermute(
                     mb_bytes, AXIS_PIPE)
                 comm_axes = comm_axes + (AXIS_PIPE,)
-            if not comm_axes and grad_sync > 0:
+            if not comm_axes and (grad_sync > 0
+                                  or cm.param_gather_time > 0):
                 comm_axes = (AXIS_DATA,)  # gradient sync rides `data`
             acc.add(node.guid,
                     compute_t,
-                    cm.comm_time + reshard + psum,
+                    cm.comm_time + reshard + psum + pg_serial,
                     comm_axes=comm_axes, sync=sync_arg,
                     overlappable_comm=overlap_comm,
                     overlap_overhead=overlap_overhead)
             mem += cm.memory
+            gather_peak = max(gather_peak, cm.gather_bytes)
             if collect is not None:
                 # compute_t may carry the pipeline bubble stretch; report
                 # the stretched split so entries still sum to compute_t
@@ -508,7 +518,7 @@ class UnitySearch:
                     "backward_s": cm.backward_time * stretch,
                     "sync_s": sync_arg,
                     "reshard_s": reshard,
-                    "collective_s": cm.comm_time + psum,
+                    "collective_s": cm.comm_time + psum + pg_serial,
                     # overlap-capable collective traffic (hidden behind
                     # this op's compute; still occupies its ICI axis) —
                     # ring hops plus, under weight-update sharding, the
@@ -516,6 +526,10 @@ class UnitySearch:
                     "overlap_s": overlap_comm,
                     "overlap_overhead_s": overlap_overhead,
                     "grad_sync_s": grad_sync_sharded,
+                    # stage-3 just-in-time weight gathers (fwd + bwd
+                    # re-gather): inside overlap_s when overlapped,
+                    # inside this node's comm when serial
+                    "param_gather_s": param_gather_s,
                     "update_shards": cm.update_shards,
                     "memory_bytes": cm.memory,
                     "comm_axes": list(comm_axes)})
@@ -527,6 +541,9 @@ class UnitySearch:
                 d["compute_s"] = c
                 d["comm_s"] = q
                 d["comm_axis_id"] = ax
+        # stage 3: the per-node memory dropped the resident gathered
+        # copies; charge the double-buffered gather working set once
+        mem += 2.0 * gather_peak
         return acc.makespan(self.graph.in_edges), mem
 
     def _expected_input(self, node, cfg, dst_idx, ndim):
@@ -927,27 +944,36 @@ def lambda_memory_search(make_search, hbm_bytes: float, iters: int = 5):
 def choose_update_sharding(graph, mesh, config,
                            cost_model: Optional[CostModel] = None,
                            opt_slots: int = 1) -> dict:
-    """Decide whether the weight update runs ZeRO-sharded (Xu et al. 2020)
-    or replicated — the update-dimension half of the Unity search, priced
+    """Decide how the weight update runs — replicated, ZeRO stage 2
+    (masters/grads/optimizer slots at 1/dp, Xu et al. 2020), or ZeRO-3 /
+    FSDP stage 3 (the trainable weights themselves sharded at rest with
+    just-in-time per-layer gathers, Rajbhandari et al. SC'20; Zhao et
+    al. VLDB'23) — the update-dimension half of the Unity search, priced
     by the same evaluator after the per-node placements are materialized
     on the graph.
 
-    The two candidates move the same ring bytes (allreduce ≡ RS+AG), so
-    the decision is exactly the paper's tradeoff: sharded wins when the
-    plan is GRAD-SYNC-BOUND (the overlappable channel hides the pair
-    behind backward compute while the replicated allreduce serializes) or
-    MEMORY-BOUND (masters + slots at 1/dp bring the plan under the
-    per-chip HBM cap); replicated wins when the model is so small that
-    the pair's fixed per-hop issue latency exceeds the sync it hides (the
-    2% margin keeps tiny CI models on the replicated baseline rather
-    than flip-flopping on pricing noise). `--weight-update-sharding` /
-    `--no-weight-update-sharding` force the outcome; both trajectories
-    are bit-identical, so forcing is always safe.
+    All three candidates move comparable ring bytes (allreduce ≡ RS+AG;
+    stage 3 re-gathers on the backward), so the decision is exactly the
+    papers' tradeoff: stage 2 wins when the plan is GRAD-SYNC-BOUND (the
+    overlappable channel hides the pair behind backward compute while
+    the replicated allreduce serializes) or MEMORY-BOUND (masters +
+    slots at 1/dp bring the plan under the per-chip HBM cap); stage 3
+    wins exactly when the plan is memory-bound past stage 2 — the
+    RESIDENT GATHERED COPIES (per-chip model bytes flat in dp) are
+    themselves over the cap, and 1/shards-at-rest weights plus at most
+    two gathered layers in flight are what fits; replicated wins when
+    the model is so small that the pair's fixed per-hop issue latency
+    exceeds the sync it hides (the 2% margin keeps tiny CI models on
+    the replicated baseline rather than flip-flopping on pricing
+    noise). `--weight-update-sharding[=stage3|stage2|off]` /
+    `--no-weight-update-sharding` force the outcome; every trajectory
+    is bit-identical, so forcing is always safe.
 
     Returns the decision record the model stashes (`_update_sharding`),
-    checkpoint manifests embed, and strategy_report.json surfaces. As a
-    side effect the cost model is left pricing the CHOSEN update mode, so
-    the explain report / drift monitor describe the running config."""
+    checkpoint manifests embed, and strategy_report.json surfaces —
+    including `stage` (0 | 2 | 3). As a side effect the cost model is
+    left pricing the CHOSEN update mode, so the explain report / drift
+    monitor describe the running config."""
     from ..fftype import CompMode
     from ..machine import batch_axes_for
     from .machine_model import machine_model_for_mesh
@@ -960,9 +986,11 @@ def choose_update_sharding(graph, mesh, config,
         shards *= axis_sizes.get(ax, 1)
     decision = {
         "enabled": False,
+        "stage": 0,
         "shards": shards,
         "axes": list(axes),
         "forced": config.weight_update_sharding,
+        "forced_stage": config.weight_update_stage,
     }
     trainable = any(
         ws.trainable
@@ -980,9 +1008,10 @@ def choose_update_sharding(graph, mesh, config,
     cap = (config.device_mem if config.device_mem > 0
            else cm.machine.chip.hbm_bytes)
 
-    def _priced(flag: bool, totals=None):
-        cm.update_sharding = flag
-        cm.overlap_update = flag and bool(config.overlap_collectives)
+    def _priced(stage: int, totals=None):
+        cm.update_sharding = stage >= 2
+        cm.param_gather = stage >= 3
+        cm.overlap_update = stage >= 2 and bool(config.overlap_collectives)
         # same overlap_sync the real evaluator prices with — the decision
         # and the strategy report must read the same makespan rule
         t, mem = evaluate_assigned_graph(
@@ -993,39 +1022,82 @@ def choose_update_sharding(graph, mesh, config,
         return t, mem, pen
 
     rep_totals: dict = {}
-    t_rep, mem_rep, c_rep = _priced(False, totals=rep_totals)
-    t_sh, mem_sh, c_sh = _priced(True)
+    t_rep, mem_rep, c_rep = _priced(0, totals=rep_totals)
+    t_s2, mem_s2, c_s2 = _priced(2)
+    s3_totals: dict = {}
+    t_s3, mem_s3, c_s3 = _priced(3, totals=s3_totals)
     sync_frac = (rep_totals.get("sync_s", 0.0) / t_rep if t_rep > 0
                  else 0.0)
+    # the ONE stage-3 trigger, shared by auto and the bare force-on: the
+    # resident gathered copies of stage 2 are over the per-chip cap and
+    # the 1/shards-at-rest pricing is actually cheaper under the penalty
+    stage3_memory_bound = mem_s2 > cap and c_s3 < c_s2
     if config.weight_update_sharding is not None:
-        # forced either way (both trajectories are bit-identical, so
-        # forcing is always safe); the candidates are still both priced so
-        # the decision record / bench ablation carry the comparison
+        # forced (every trajectory is bit-identical, so forcing is
+        # always safe); the candidates are still all priced so the
+        # decision record / bench ablation carry the comparison
         enabled = config.weight_update_sharding
+        if not enabled:
+            stage = 0
+        elif config.weight_update_stage in (2, 3):
+            stage = config.weight_update_stage
+        else:
+            # bare legacy --weight-update-sharding: sharded forced on,
+            # the stage still priced
+            stage = 3 if stage3_memory_bound else 2
+        decision["reason"] = "flag"
+    elif config.weight_update_stage == 0:
+        # stage forced to replicated (programmatic weight_update_stage=0
+        # without the boolean flag): honored exactly like =off
+        enabled = False
+        stage = 0
         decision["reason"] = "flag"
     else:
         # grad-sync-bound: the replicated allreduce is a material slice
         # (≥10%) of the predicted step AND the overlappable pricing is
         # ≥2% cheaper — tiny models whose sync the hop latency would
         # dominate stay replicated rather than flip-flop on noise
-        memory_bound = mem_rep > cap and c_sh < c_rep
-        overlap_bound = c_sh < 0.98 * c_rep and sync_frac >= 0.1
+        memory_bound = mem_rep > cap and min(c_s2, c_s3) < c_rep
+        overlap_bound = c_s2 < 0.98 * c_rep and sync_frac >= 0.1
         enabled = memory_bound or overlap_bound
-        decision["reason"] = ("memory_bound" if memory_bound
-                              else "overlap_bound" if overlap_bound
-                              else "replicated_cheaper")
-    decision["enabled"] = enabled
+        if not enabled:
+            stage = 0
+            decision["reason"] = "replicated_cheaper"
+        elif config.weight_update_stage in (2, 3):
+            # enablement stayed auto, but a set weight_update_stage PINS
+            # the stage used when sharding wins (the documented 2/3 =
+            # forced contract — e.g. cap at stage 2 programmatically)
+            stage = config.weight_update_stage
+            decision["reason"] = ("memory_bound" if memory_bound
+                                  else "overlap_bound")
+        elif stage3_memory_bound:
+            stage = 3
+            decision["reason"] = "memory_bound"
+        else:
+            stage = 2
+            decision["reason"] = ("memory_bound" if memory_bound
+                                  else "overlap_bound")
+    decision["enabled"] = bool(enabled) and stage >= 2
+    decision["stage"] = stage if decision["enabled"] else 0
+    t_sh, mem_sh, c_sh = ((t_s3, mem_s3, c_s3) if stage == 3
+                          else (t_s2, mem_s2, c_s2))
     decision["predicted"] = {
         "replicated_s": t_rep, "sharded_s": t_sh,
         "replicated_cost_s": c_rep, "sharded_cost_s": c_sh,
         "replicated_mem_bytes": mem_rep, "sharded_mem_bytes": mem_sh,
+        "stage2_s": t_s2, "stage3_s": t_s3,
+        "stage2_cost_s": c_s2, "stage3_cost_s": c_s3,
+        "stage2_mem_bytes": mem_s2, "stage3_mem_bytes": mem_s3,
+        "param_gather_s": s3_totals.get("param_gather_s", 0.0),
         "grad_sync_fraction": sync_frac,
         "hbm_cap_bytes": cap,
     }
     # leave the cost model pricing the chosen mode (the strategy report
     # and the drift monitor's predicted makespan must describe what runs)
-    cm.update_sharding = enabled
-    cm.overlap_update = enabled and bool(config.overlap_collectives)
+    cm.update_sharding = decision["enabled"]
+    cm.param_gather = decision["stage"] == 3
+    cm.overlap_update = (decision["enabled"]
+                         and bool(config.overlap_collectives))
     return decision
 
 

@@ -48,12 +48,16 @@ _SEARCH_CONFIG_FIELDS = (
     # compute + comm (search/cost_model.py) — toggling it can flip the
     # winning strategy, so plans must not share an address across it
     "overlap_collectives",
-    # weight-update sharding (ZeRO-style sharded optimizer): forcing it
-    # changes how the search prices grad sync + per-chip memory, and the
-    # raw None/True/False is the deterministic input to the update-mode
+    # weight-update sharding (ZeRO-style sharded optimizer / ZeRO-3
+    # FSDP): forcing it changes how the search prices grad sync +
+    # per-chip memory, and the raw None/True/False plus the forced stage
+    # (None/0/2/3) are the deterministic inputs to the update-mode
     # decision (unity.choose_update_sharding) — plans must not share an
-    # address across it
+    # address across either, so the CHOSEN stage is part of the plan
+    # fingerprint by construction (the decision is a pure function of
+    # these fields + graph + mesh + calibration)
     "weight_update_sharding",
+    "weight_update_stage",
     "computation_dtype", "allow_tensor_op_math_conversion",
     "force_tensor_op_math",
     # serving (serving/): a decode graph compiles under
