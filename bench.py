@@ -660,6 +660,73 @@ def _warmstart_legs() -> dict:
     }
 
 
+def _migration_legs(cfg, on_tpu: bool) -> dict:
+    """fftrans migration leg: measured in-process migration seconds vs
+    the TransitionPlan's predicted cost (docs/analysis.md "Transition
+    verification") — a dp stage-3 trained model migrated live to a
+    replicated hybrid mesh, no checkpoint-restart round trip. The
+    measured/predicted fidelity ratio is the datapoint the future
+    re-planner's pay-off rule needs: a re-shard pays for itself only
+    when the predicted migration seconds (this leg calibrates the
+    prediction) undercut the drift it removes."""
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import build_transformer_lm
+    from flexflow_tpu.resilience import migrate_state
+
+    n_dev = jax.device_count()
+    if n_dev < 4:
+        return {"skipped": f"{n_dev} device(s) — no cross-mesh migration"}
+
+    def build(mesh, stage3):
+        # argv is restored below: a leg failure must not leak the
+        # stage-3 flag into the later warm-start legs' FFConfig parse
+        sys.argv = [sys.argv[0]] + (
+            ["--weight-update-sharding=stage3"] if stage3 else [])
+        config = FFConfig()
+        config.mesh_axis_sizes = mesh
+        config.batch_size = 4
+        ff = FFModel(config)
+        build_transformer_lm(ff, cfg, batch_size=4)
+        ff.compile(optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+                   loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        return ff
+
+    saved_argv = list(sys.argv)
+    try:
+        old = build((4, 1, 1, 1), stage3=True)
+        rs = np.random.RandomState(0)
+        X = {"tokens": rs.randint(
+                0, cfg.vocab_size,
+                (4, cfg.sequence_length)).astype(np.int32),
+             "positions": np.tile(
+                 np.arange(cfg.sequence_length, dtype=np.int32), (4, 1))}
+        Y = rs.randint(0, cfg.vocab_size,
+                       (4, cfg.sequence_length, 1)).astype(np.int32)
+        old.fit(X, Y, epochs=1, batch_size=4, shuffle=False,
+                verbose=False)
+        new = build((2, 2, 1, 1), stage3=False)
+        section = migrate_state(old, new)
+    finally:
+        sys.argv = saved_argv
+    predicted = section["predicted_s"]
+    measured = section["measured_s"]
+    return {
+        "transfers": len(section["transfers"]),
+        "bytes_on_wire": int(sum(section["bytes_on_wire"].values())),
+        "predicted_s": round(predicted, 6),
+        "measured_s": round(measured, 6),
+        # >1 = the plan is optimistic on this backend (XLA:CPU pays
+        # dispatch per leaf); the re-planner consumes this ratio as its
+        # calibration factor
+        "measured_vs_predicted": (round(measured / predicted, 4)
+                                  if predicted > 0 else None),
+        "stage3_src": True,
+        "errors": (section.get("analysis") or {}).get("errors"),
+    }
+
+
 def _serving_legs(cfg, on_tpu: bool) -> dict:
     """Serving legs: requests/s/chip + decode tokens/s/chip through the
     continuous-batching engine (serving/) — the ROADMAP's "millions of
@@ -940,6 +1007,23 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
     except Exception as e:  # pragma: no cover - defensive
         print(f"bench: serving leg failed: {e}", file=sys.stderr)
 
+    # migration leg (fftrans): measured in-process migration seconds vs
+    # the TransitionPlan's prediction on this mesh — the cost-model
+    # fidelity datapoint the re-planner's pay-off rule will consume
+    migration = None
+    try:
+        migration = _migration_legs(cfg, on_tpu)
+        print(json.dumps({
+            "metric": "migration_seconds",
+            **{k: migration[k] for k in
+               ("predicted_s", "measured_s", "measured_vs_predicted",
+                "transfers", "bytes_on_wire")
+               if k in migration},
+            "unit": "s",
+        }))
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"bench: migration leg failed: {e}", file=sys.stderr)
+
     # warm-start legs: cold-vs-warm time-to-first-step against one shared
     # --warmstart-dir (secondary line + archived in the primary payload)
     warmstart = None
@@ -978,6 +1062,8 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
         payload["param_sharding"] = param_sharding
     if serving is not None:
         payload["serving"] = serving
+    if migration is not None:
+        payload["migration"] = migration
     if warmstart is not None:
         payload["warmstart"] = warmstart
     if tokens_per_sec is None:

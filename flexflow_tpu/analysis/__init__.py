@@ -33,6 +33,16 @@ Passes (docs/analysis.md has the full catalog):
    hosts the opt-in runtime fingerprint barrier (spmd.py,
    `--spmd-barrier`).
 
+A third static-analysis layer, **fftrans** (transition.py), verifies the
+TRANSITION between two plans for the same PCG — state-mapping
+completeness, gather paths out of ZeRO at-rest layouts, transition-time
+memory, ring bijectivity + topological transfer order, and schedule
+uniformity — and prices the migration (`predicted_s` reproduces from the
+strategy-report `transition` section alone). It gates the elastic-resume
+restore path (resilience/reshard.py) and the in-process live migration
+(resilience/migrate.py), the gating half of live re-planning
+(ROADMAP item 2).
+
 Findings land in the `analysis` section of strategy_report.json
 (severity error/warning/info); errors abort compile unless
 `--no-verify-plan`. `scripts/fflint.py` runs the source-level hazard
@@ -55,6 +65,7 @@ from . import (
     sharding,
     sources,
     spmd,
+    transition,
 )
 from .findings import (
     AnalysisResult,
@@ -70,7 +81,7 @@ __all__ = [
     "PlanVerificationError", "run_analysis", "verify_plan",
     "verify_strategy", "PASSES", "SEV_ERROR", "SEV_WARNING", "SEV_INFO",
     "collectives", "donation", "lint", "memory", "numerics", "sharding",
-    "sources", "spmd",
+    "sources", "spmd", "transition",
 ]
 
 # (name, runner) in execution order; each runner is

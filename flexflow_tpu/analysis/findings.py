@@ -40,8 +40,17 @@ _SEVERITIES = (SEV_ERROR, SEV_WARNING, SEV_INFO)
 #                        downcast_roundtrip, parallel_dtype_mismatch,
 #                        numerics_clean
 #   spmd uniformity:     host_divergent_branch, spmd_clean
+#   transition (fftrans): dropped_state, unmapped_state,
+#                        state_dtype_change, state_shape_change,
+#                        missing_gather_path, kv_pool_mismatch,
+#                        transition_oom, transition_memory_timeline,
+#                        bad_transfer_permutation,
+#                        nontopological_transfer_order,
+#                        migration_donation_hazard,
+#                        transfer_schedule_divergence, transition_clean
 #   lint (fflint rules): host_sync_in_loop, unsorted_dict_hash,
-#                        global_rng, time_in_trace
+#                        global_rng, time_in_trace,
+#                        unverified_transition
 
 
 @dataclass

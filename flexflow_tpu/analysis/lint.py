@@ -43,6 +43,15 @@ Every rule encodes a bug class a past PR fixed by hand:
   arrive — error) or a trace-entry call (hosts compile divergent
   executables — warning). The r13 multihost pricing divergence
   generalized: gate on a BROADCAST value, never a locally measured one.
+- `unverified_transition` — a direct call to one of the state
+  re-placement appliers (`place_update_sharded`, `place_like`,
+  `restore_tree`) in a function that never consults the fftrans
+  transition checker (analysis/transition.py). Re-placing live/restored
+  state outside the checker-gated path is exactly how a dropped
+  mapping, dtype drift, or a stage-3 shard without a gather path
+  becomes a shape crash or silent corruption mid-restore — route
+  through `migrate_state` / `verify_restore_transition` (a fresh-init
+  placement at compile is not a transition: pragma it).
 
 Suppression: a trailing `# fflint: ok` (optionally naming codes,
 `# fflint: ok host_sync_in_loop`) on the flagged line or its enclosing
@@ -64,7 +73,8 @@ PASS_NAME = "fflint"
 
 ALL_RULES = ("host_sync_in_loop", "unsorted_dict_hash", "global_rng",
              "time_in_trace", "coordinator_collective", "donated_reuse",
-             "low_precision_accum", "host_divergent_branch")
+             "low_precision_accum", "host_divergent_branch",
+             "unverified_transition")
 
 # identifiers whose presence in an `if` test marks the branch as a
 # telemetry/diagnostics gate (a gated fetch is the sanctioned pattern)
@@ -105,6 +115,16 @@ DONATED_CALLEES = {
 }
 
 _HASH_FN_HINTS = ("fingerprint", "signature", "digest", "_sha", "hash")
+
+# state re-placement appliers (the reshard-apply surface) and the
+# fftrans checker entry points that gate them (analysis/transition.py,
+# resilience/migrate.py) — a function calling an applier must also
+# consult a checker, or the re-placement runs unverified
+_TRANSITION_APPLIERS = {"place_update_sharded", "place_like",
+                        "restore_tree"}
+_TRANSITION_CHECKERS = {"verify_restore_transition", "verify_transition",
+                        "gate_transition", "build_transition_plan",
+                        "plan_model_transition", "migrate_state"}
 
 # summing reductions the low-precision-accumulation rule watches
 # (order statistics — max/min/argmax — carry no accumulation error)
@@ -636,6 +656,49 @@ class _FileLint:
                                 f"pricing-divergence class); key the "
                                 f"decision on broadcast state",
                                 source=src)
+
+    # ------------------------------------ rule: unverified transition
+
+    def _enclosing_def(self, node):
+        cur = self._parents.get(id(node))
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cur = self._parents.get(id(cur))
+        return cur
+
+    def rule_unverified_transition(self):
+        calls = [n for n in ast.walk(self.tree)
+                 if isinstance(n, ast.Call)
+                 and _last_ident(n.func) in _TRANSITION_APPLIERS]
+        if not calls:
+            return
+        # checker references per enclosing def (None = module level):
+        # any Name/Attribute mention counts — the gate may be called,
+        # passed, or imported-and-called under an alias attribute
+        gated_scopes: set[int] = set()
+        for node in ast.walk(self.tree):
+            ident = ""
+            if isinstance(node, ast.Name):
+                ident = node.id
+            elif isinstance(node, ast.Attribute):
+                ident = node.attr
+            if ident in _TRANSITION_CHECKERS:
+                scope = self._enclosing_def(node)
+                gated_scopes.add(id(scope) if scope is not None else 0)
+        for call in calls:
+            scope = self._enclosing_def(call)
+            sid = id(scope) if scope is not None else 0
+            if sid in gated_scopes:
+                continue
+            callee = _last_ident(call.func)
+            self._emit(
+                call, SEV_WARNING, "unverified_transition",
+                f"{callee}() re-places state outside the fftrans "
+                f"checker-gated path — a dropped mapping / dtype drift "
+                f"/ missing gather path here surfaces as corruption "
+                f"mid-restore; route through migrate_state / "
+                f"verify_restore_transition (fresh-init placement at "
+                f"compile is exempt: pragma it)")
 
     # ---------------------------------------------------------------- run
 

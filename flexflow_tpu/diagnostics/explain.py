@@ -332,6 +332,15 @@ def build_strategy_report(model) -> dict:
         getattr(model.config, "sanitize_numerics", False))
     report["spmd_barrier"] = (
         getattr(model, "_spmd_barrier", None) or {}).get("status", "off")
+    transition = getattr(model, "_transition", None)
+    if transition is not None:
+        # fftrans (analysis/transition.py): the verified + priced
+        # TransitionPlan of the restore/migration this model went
+        # through — predicted_s reproduces from the per-transfer entries
+        # alone (verify_transition_total, the makespan-identity
+        # treatment), which is the datapoint the re-planner's pay-off
+        # rule consumes
+        report["transition"] = transition
     return report
 
 
@@ -359,6 +368,19 @@ def render_markdown(report: dict) -> str:
         f"- ffsan: sanitizer "
         f"{'ON' if report.get('sanitize_numerics') else 'off'}"
         f"  ·  SPMD barrier: {report.get('spmd_barrier', 'off')}")
+    if report.get("transition"):
+        t = report["transition"]
+        ta = t.get("analysis") or {}
+        wire = sum((t.get("bytes_on_wire") or {}).values())
+        lines.append(
+            f"- plan transition (fftrans): {len(t.get('transfers', []))} "
+            f"transfer(s), predicted {t.get('predicted_s', 0.0) * 1e3:.3f}"
+            f" ms"
+            + (f" (measured {t['measured_s'] * 1e3:.3f} ms)"
+               if t.get("measured_s") is not None else "")
+            + f", {wire / 2**20:.2f} MiB on wire — "
+            f"{ta.get('errors', '?')} error(s), "
+            f"{ta.get('warnings', '?')} warning(s)")
     if report.get("update_sharding"):
         stage = report.get("update_stage", 2)
         lines.append(

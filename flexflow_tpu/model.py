@@ -1253,7 +1253,9 @@ class FFModel:
         # optimizer slots inherit the (possibly update-sharded) param
         # placement via zeros_like; place_update_sharded is the explicit
         # guarantee (momentum-off scalar slots pass through untouched)
-        self._opt_slots = self.executor.place_update_sharded(
+        # fresh-init placement of just-built zeros at compile — not a
+        # plan transition, nothing pre-existing to verify a mapping for
+        self._opt_slots = self.executor.place_update_sharded(  # fflint: ok unverified_transition
             self.executor.replicate(self.optimizer.init(self._params)))
         self._state = self.executor.replicate(self._state) if self._state else self._state
         self._step = self.executor.replicate(jnp.zeros((), jnp.int32))

@@ -17,6 +17,15 @@ get/set_tensor). This package makes the framework survive real pods:
 - `fault`: deterministic kill-after-step-K injection for tests.
 - `manager`: ResilienceManager gluing the above into FFModel.fit, plus the
   `auto_resume` entry point.
+- `migrate`: in-process live-state migration between two compiled plans
+  (`migrate_state`) — the fftrans apply path (analysis/transition.py):
+  the transition is statically verified and priced before any leaf
+  moves, and no checkpoint-restart round trip is paid.
+
+Every restore and migration is gated by the fftrans transition verifier
+(`reshard.verify_restore_transition` / `analysis.transition`): an
+incompatible mapping raises PlanVerificationError naming the leaf and
+finding class instead of shape-crashing mid-restore.
 """
 
 from .checkpointer import (
@@ -28,8 +37,9 @@ from .checkpointer import (
 )
 from .fault import FaultInjector, SimulatedPreemption
 from .manager import ResilienceManager, auto_resume
+from .migrate import migrate_state
 from .policy import CheckpointPolicy, PreemptionHandler
-from .reshard import restore_model, restore_tree
+from .reshard import restore_model, restore_tree, verify_restore_transition
 
 __all__ = [
     "AsyncCheckpointer",
@@ -43,6 +53,8 @@ __all__ = [
     "latest_checkpoint",
     "list_checkpoints",
     "load_checkpoint",
+    "migrate_state",
     "restore_model",
     "restore_tree",
+    "verify_restore_transition",
 ]

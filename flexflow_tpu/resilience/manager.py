@@ -146,6 +146,13 @@ class ResilienceManager:
             extras = restore_model(self.ffmodel, path)
         telemetry.event("restore", path=path,
                         duration_s=time.perf_counter() - t0)
+        # the fftrans gate stashed the verified TransitionPlan on the
+        # model — land it in strategy_report.json (the compile-time
+        # report predates the restore) so run_doctor sees the
+        # transition section on elastic-resume runs too
+        from .migrate import _rewrite_report
+
+        _rewrite_report(self.ffmodel)
         return extras
 
     def restore_latest(self) -> Optional[dict]:

@@ -29,6 +29,36 @@ import numpy as np
 from .checkpointer import CheckpointCorruptError, load_checkpoint
 
 
+def verify_restore_transition(ffmodel, flat: dict, manifest: dict,
+                              label: str = "checkpoint"):
+    """The fftrans verify-before-apply gate (analysis/transition.py):
+    build the checkpoint→model TransitionPlan from the manifest + flat
+    arrays + the restoring compile's materialized placements, verify it
+    (state-mapping completeness, dtype/shape preservation, gather paths,
+    transition-time memory, schedule uniformity), and refuse an
+    unverifiable mapping with a PlanVerificationError NAMING the leaf
+    and finding class — instead of the shape crash or silent dtype
+    drift mid-restore it used to be. --no-verify-plan downgrades to
+    warnings (the strict restore_tree checks below remain the
+    backstop). The verified plan lands on `ffmodel._transition` so the
+    strategy report of the restoring run carries the `transition`
+    section."""
+    from ..analysis import transition as fftrans
+    from ..search.machine_model import machine_model_for_mesh
+
+    machine = machine_model_for_mesh(
+        ffmodel.mesh, num_hosts=ffmodel.config.num_nodes)
+    cap = (ffmodel.config.device_mem if ffmodel.config.device_mem > 0
+           else machine.chip.hbm_bytes)
+    plan = fftrans.build_transition_plan(
+        fftrans.PlanSide.from_checkpoint(flat, manifest, label=label),
+        fftrans.PlanSide.from_model(ffmodel, label="restoring-model"),
+        machine=machine, hbm_cap_bytes=cap)
+    result = fftrans.gate_transition(plan, ffmodel.config, label=label)
+    ffmodel._transition = plan.to_json(analysis=result)
+    return plan, result
+
+
 def place_like(host_arr: np.ndarray, template_leaf):
     """Place one host array like `template_leaf`: same dtype, and the
     template's NamedSharding when it has one (the cross-mesh re-placement).
@@ -65,7 +95,9 @@ def restore_tree(template, flat_arrays: dict[str, np.ndarray], prefix: str = "",
             raise CheckpointCorruptError(
                 f"{label}: leaf {key} has shape {tuple(saved.shape)} but the "
                 f"compiled model expects {want} — architecture mismatch")
-        leaves.append(place_like(saved, leaf))
+        # the fftrans gate runs one level up (restore_model calls
+        # verify_restore_transition before any leaf is re-placed)
+        leaves.append(place_like(saved, leaf))  # fflint: ok unverified_transition
     if missing:
         raise CheckpointCorruptError(
             f"{label}: {len(missing)} leaves absent from checkpoint "
@@ -96,6 +128,13 @@ def restore_model(ffmodel, path: str) -> dict:
     extras dict (train-loop cursor, wallclock, saving mesh...)."""
     assert ffmodel._compiled, "compile() before restoring a checkpoint"
     flat, manifest = load_checkpoint(path)
+
+    # fftrans verify-before-apply: cross-mesh / update-stage-toggle
+    # restores are plan transitions — statically verify the mapping
+    # BEFORE any leaf is re-placed (PlanVerificationError names the leaf
+    # and finding class; --no-verify-plan downgrades to warnings and the
+    # strict checks below stay as the backstop)
+    verify_restore_transition(ffmodel, flat, manifest, label=path)
 
     saved_state_keys = [k for k in flat if k.startswith("['state']")]
     template = model_state_tree(ffmodel)

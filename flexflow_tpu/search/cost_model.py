@@ -128,6 +128,37 @@ def price_param_gather(cm: "CostMetrics", overlap_update: bool
     return pg + cm.param_gather_hop_s, 0.0, 0.0, pg
 
 
+def price_transfer_collective(kind: str, wire_bytes: float,
+                              out_bytes: float, axis: str,
+                              machine: "TPUMachineModel | None") -> float:
+    """Seconds of ONE migration transfer collective (fftrans,
+    analysis/transition.py) — the pricing rule the TransitionPlan's
+    predicted_s is built from, kept here so migration is priced by the
+    same machine-model oracle as every other collective the search
+    prices. Kinds: all_gather / all_to_all (the GSPMD-derived unwinds,
+    priced per axis), host_hop (a full logical array through the host
+    NIC at DCN bandwidth), slice (free local dynamic-slice). With no
+    machine model (pricing a checkpoint side standalone), falls back to
+    the conservative dcn figure of the detected chip."""
+    if kind == "slice" or wire_bytes <= 0:
+        return 0.0
+    if machine is None:
+        from .machine_model import detect_chip
+
+        chip = detect_chip()
+        return wire_bytes / chip.dcn_bandwidth + chip.dcn_latency
+    if kind == "host_hop":
+        return (wire_bytes / machine.chip.dcn_bandwidth
+                + machine.chip.dcn_latency)
+    if kind == "all_gather":
+        return machine.all_gather(out_bytes, axis)
+    if kind == "all_to_all":
+        # out_bytes is the per-chip send size; the oracle applies the
+        # (n-1)/n wire fraction itself
+        return machine.all_to_all(out_bytes, axis)
+    return wire_bytes / machine.chip.dcn_bandwidth
+
+
 def _shard_elems(shape: tuple[int, ...], assignment, axis_sizes) -> float:
     """Per-chip element count of a tensor under an axis assignment."""
     n = 1.0

@@ -18,6 +18,10 @@ analysis.md has the catalog):
   host_divergent_branch    per-host-nondeterministic branch (time/RNG/
                            env/hostname) guarding a collective or a
                            trace entry — the r13 divergence class
+  unverified_transition    a state re-placement applier
+                           (place_update_sharded / place_like /
+                           restore_tree) in a function that never
+                           consults the fftrans transition checker
 
 Suppression: trailing `# fflint: ok [codes]` on the line or its `def`.
 

@@ -1,0 +1,203 @@
+"""fftrans migration smoke: verified in-process plan migration on the CPU mesh.
+
+The CI gate for the transition verifier + migrate path (docs/analysis.md
+"Transition verification"): trains a small LM on dp=4 under ZeRO stage 3
+(params sharded at rest — the transition that NEEDS a gather path), then
+migrates the live state in-process to a dp=2×tp=2 replicated compile via
+`migrate_state`, with a checkpoint-restart control restoring the same
+state the classic way, and asserts
+
+  - the TransitionPlan verified clean (zero errors across all five
+    fftrans passes) and the stage-3 transfers record their gather path;
+  - strategy_report.json carries the `transition` section with
+    predicted_s REPRODUCING from the per-transfer entries alone
+    (verify_transition_total — the ffcheck-identity treatment; the
+    run_doctor --check step in CI re-verifies the same artifact);
+  - measured migration seconds landed next to the prediction (the
+    fidelity datapoint the re-planner's pay-off rule needs);
+  - the migrated state is BIT-EXACT vs the checkpoint-restart control —
+    params, optimizer slots, counters, step — and every migrated leaf
+    carries the NEW compile's sharding;
+  - one more epoch on each continues bit-exactly (identical losses by
+    identical params at every step);
+  - telemetry carries the transition_verify + migrate events.
+
+Usage: python scripts/migrate_smoke.py --telemetry-dir OUT [flexflow flags]
+Exits nonzero with a diagnostic on any violated assertion.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# virtual 8-device CPU mesh, exactly like tests/conftest.py
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def fail(msg: str):
+    print(f"migrate_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _flat(tree):
+    import jax.tree_util as jtu
+
+    return {jtu.keystr(p): np.asarray(v)
+            for p, v in jtu.tree_flatten_with_path(tree)[0]}
+
+
+def _build(mesh, extra_argv, base_argv, cfg, TelemetryDir=None):
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import build_transformer_lm
+
+    sys.argv = [sys.argv[0]] + list(base_argv) + list(extra_argv)
+    config = FFConfig()
+    config.mesh_axis_sizes = mesh
+    config.batch_size = 4
+    ff = FFModel(config)
+    build_transformer_lm(ff, cfg, batch_size=4)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def main():
+    from flexflow_tpu.analysis.transition import verify_transition_total
+    from flexflow_tpu.models import TransformerLMConfig
+    from flexflow_tpu.resilience import migrate_state
+    from flexflow_tpu.telemetry import read_jsonl
+
+    argv = sys.argv[1:]
+    tdir = ""
+    if "--telemetry-dir" in argv:
+        tdir = argv[argv.index("--telemetry-dir") + 1]
+    if not tdir:
+        fail("pass --telemetry-dir")
+    # the telemetry/diagnostics session belongs to the MIGRATED model —
+    # its strategy report is the artifact under test
+    base = [a for i, a in enumerate(argv)
+            if a not in ("--telemetry-dir", "--diagnostics")
+            and (i == 0 or argv[i - 1] != "--telemetry-dir")]
+
+    cfg = TransformerLMConfig(
+        vocab_size=128, hidden_size=64, num_heads=2, num_layers=2,
+        sequence_length=32)
+    rs = np.random.RandomState(0)
+    n = 8
+    X = {"tokens": rs.randint(0, cfg.vocab_size,
+                              (n, cfg.sequence_length)).astype(np.int32),
+         "positions": np.tile(
+             np.arange(cfg.sequence_length, dtype=np.int32), (n, 1))}
+    Y = rs.randint(0, cfg.vocab_size,
+                   (n, cfg.sequence_length, 1)).astype(np.int32)
+
+    # 1) old plan: dp=4, ZeRO stage 3 — params sharded at rest
+    old = _build((4, 1, 1, 1), ["--weight-update-sharding=stage3"],
+                 base, cfg)
+    if (old._update_sharding or {}).get("stage") != 3:
+        fail(f"old compile did not run stage 3: {old._update_sharding}")
+    old.fit(X, Y, epochs=1, batch_size=4, shuffle=False, verbose=False)
+
+    # 2) checkpoint-restart CONTROL onto the new plan
+    ckroot = tempfile.mkdtemp(prefix="migrate_smoke_ck_")
+    old.save_checkpoint(ckroot)
+    ctrl = _build((2, 2, 1, 1), [], base, cfg)
+    ctrl.load_checkpoint(ckroot)
+
+    # 3) verified in-process migration onto an identical new compile
+    mig = _build((2, 2, 1, 1),
+                 ["--telemetry-dir", tdir, "--diagnostics"], base, cfg)
+    section = migrate_state(old, mig)
+
+    analysis = section.get("analysis") or {}
+    if analysis.get("errors", 1) != 0:
+        fail(f"transition verification reported errors: {analysis}")
+    if sorted(analysis.get("passes_run", [])) != sorted(
+            ("state_mapping", "transition_memory", "transfer_collectives",
+             "migration_donation", "transfer_uniformity")):
+        fail(f"fftrans passes incomplete: {analysis.get('passes_run')}")
+    sharded = [t for t in section["transfers"] if t.get("update_sharded")]
+    if not sharded:
+        fail("no stage-3 transfer in the plan — the scenario degenerated")
+    for t in sharded:
+        if not any(c["kind"] == "all_gather" for c in t["collectives"]):
+            fail(f"stage-3 transfer {t['key']} records no gather path")
+    if section.get("measured_s") is None or section["measured_s"] < 0:
+        fail("no measured migration seconds on the executed plan")
+
+    # 4) bit-exact vs the checkpoint-restart control
+    for name, a, b in (("params", ctrl._params, mig._params),
+                       ("opt_slots", ctrl._opt_slots, mig._opt_slots),
+                       ("counters", ctrl._counters, mig._counters)):
+        fa, fb = _flat(a), _flat(b)
+        if fa.keys() != fb.keys():
+            fail(f"{name} key sets differ after migration")
+        for k in fa:
+            if not np.array_equal(fa[k], fb[k]):
+                fail(f"migrated {name}{k} != checkpoint-restart control")
+    if int(ctrl._step) != int(mig._step):
+        fail(f"step counter {int(mig._step)} != control {int(ctrl._step)}")
+    import jax.tree_util as jtu
+
+    for _p, leaf in jtu.tree_flatten_with_path(mig._params)[0]:
+        if leaf.sharding.mesh.shape != mig.mesh.shape:
+            fail("a migrated leaf does not carry the new mesh's sharding")
+
+    # 5) losses continue bit-exact: one more epoch each, identical
+    # params at the end imply identical losses at every step
+    ctrl.fit(X, Y, epochs=1, batch_size=4, shuffle=False, verbose=False)
+    mig.fit(X, Y, epochs=1, batch_size=4, shuffle=False, verbose=False)
+    fa, fb = _flat(ctrl._params), _flat(mig._params)
+    for k in fa:
+        if not np.array_equal(fa[k], fb[k]):
+            fail(f"post-migration trajectory diverged at {k}")
+
+    # 6) the report artifact: transition section + the identity
+    report_path = os.path.join(tdir, "strategy_report.json")
+    if not os.path.exists(report_path):
+        fail(f"missing strategy report {report_path}")
+    with open(report_path) as f:
+        report = json.load(f)
+    t = report.get("transition")
+    if not t:
+        fail("strategy report has no transition section")
+    total = verify_transition_total(t)
+    want = t.get("predicted_s", 0.0)
+    if abs(total - want) > 1e-9 + 1e-6 * abs(want):
+        fail(f"transition identity broken: verify={total} vs "
+             f"report={want}")
+    if not t.get("bytes_on_wire"):
+        fail("transition section carries no bytes-on-wire accounting")
+
+    # 7) telemetry events
+    recs = list(read_jsonl(os.path.join(tdir, "metrics.jsonl")))
+    tv = [r for r in recs if r.get("kind") == "transition_verify"]
+    mg = [r for r in recs if r.get("kind") == "migrate"]
+    if not tv or tv[0].get("errors", 1) != 0:
+        fail(f"transition_verify event missing/unclean: {tv[:1]}")
+    if not mg or mg[0].get("measured_s") is None:
+        fail(f"migrate event missing measured_s: {mg[:1]}")
+
+    print(f"migrate_smoke: OK — {len(section['transfers'])} transfers "
+          f"(stage-3 gather paths on {len(sharded)}), predicted "
+          f"{section['predicted_s'] * 1e3:.3f} ms / measured "
+          f"{section['measured_s'] * 1e3:.1f} ms, "
+          f"{sum(section['bytes_on_wire'].values()) / 2**20:.2f} MiB on "
+          f"wire, bit-exact vs checkpoint-restart incl. one continued "
+          f"epoch, identity holds")
+
+
+if __name__ == "__main__":
+    main()

@@ -75,6 +75,29 @@ def main():
                     f"SPMD fingerprint barrier verdict "
                     f"{rep.get('spmd_barrier')!r} — the fleet diverged "
                     f"before the first step")
+            # fftrans gate: when the run went through a verified plan
+            # transition (restore/migration), the section's predicted
+            # migration seconds must reproduce from the per-transfer
+            # entries alone — the same identity treatment as the
+            # makespan check above
+            trans = rep.get("transition")
+            if trans is not None:
+                from flexflow_tpu.analysis.transition import (
+                    verify_transition_total,
+                )
+
+                tt = verify_transition_total(trans)
+                want = trans.get("predicted_s", 0.0)
+                if abs(tt - want) > 1e-9 + 1e-6 * abs(want):
+                    problems.append(
+                        f"transition section per-transfer costs ({tt}) "
+                        f"do not reproduce predicted_s ({want})")
+                ta = trans.get("analysis") or {}
+                if ta.get("errors", 0):
+                    problems.append(
+                        f"transition verification recorded "
+                        f"{ta['errors']} error(s) — the migration ran "
+                        f"unverified (--no-verify-plan)")
         if problems:
             print("run_doctor: CHECK FAILED: " + "; ".join(problems),
                   file=sys.stderr)

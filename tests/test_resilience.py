@@ -458,7 +458,11 @@ def test_deprecated_checkpoint_api_roundtrips(tmp_path):
 
 def test_restore_rejects_architecture_mismatch(tmp_path):
     """Leaf mismatches raise loudly instead of silently dropping state (the
-    old `_state or {}` failure mode)."""
+    old `_state or {}` failure mode). Since fftrans the refusal comes from
+    the verify-before-apply transition gate (PlanVerificationError naming
+    the leaf and finding class) BEFORE any re-placement; the
+    CheckpointCorruptError path stays as the --no-verify-plan backstop."""
+    from flexflow_tpu.analysis import PlanVerificationError
     from flexflow_tpu.resilience import CheckpointCorruptError
 
     ff = _mlp()
@@ -481,7 +485,8 @@ def test_restore_rejects_architecture_mismatch(tmp_path):
     other.compile(optimizer=SGDOptimizer(lr=0.05),
                   loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
                   metrics=[MetricsType.METRICS_ACCURACY])
-    with pytest.raises(CheckpointCorruptError, match="shape"):
+    with pytest.raises((CheckpointCorruptError, PlanVerificationError),
+                       match="shape"):
         other.load_checkpoint(path)
 
 

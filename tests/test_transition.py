@@ -1,0 +1,479 @@
+"""fftrans transition-verifier tests (analysis/transition.py,
+resilience/migrate.py, docs/analysis.md "Transition verification").
+
+The acceptance surface: a transition-corruption fuzzer injects each of
+the six corruption classes into a real (old plan → new plan) transition
+— dropped weight mapping, dtype change, stage3→off without a gather
+path, non-bijective transfer ring, over-cap transition peak, KV-pool
+block-size mismatch — and asserts the verifier reports EXACTLY that
+finding class; every cross-mesh / stage-toggle elastic-resume path the
+suite exercises verifies with zero errors; `migrate_state` is bit-exact
+vs checkpoint-restart (state AND continued trajectory); the
+verify-before-apply restore gate refuses unverifiable mappings with a
+PlanVerificationError naming the leaf + class (--no-verify-plan
+downgrades); and the strategy-report `transition` section's predicted
+seconds reproduce from the JSON alone (the ffcheck-identity treatment).
+"""
+
+import json
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.quick
+
+DP8 = (8, 1, 1, 1)
+DP4 = (4, 1, 1, 1)
+DP4_TP2 = (4, 2, 1, 1)
+DP2_TP2 = (2, 2, 1, 1)
+DP2_PP4 = (2, 1, 4, 1)
+
+
+def _mlp(batch=8, mesh=DP4, seed=0, argv=(), momentum=0.9):
+    sys.argv = ["test", *argv]
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, SGDOptimizer,
+    )
+
+    config = FFConfig()
+    config.mesh_axis_sizes = mesh
+    config.batch_size = batch
+    config.seed = seed
+    ff = FFModel(config)
+    x = ff.create_tensor((batch, 16), name="x")
+    t = ff.dense(x, 32, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 4, name="fc2")
+    t = ff.softmax(t, name="sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.05, momentum=momentum),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def _data(n=16, d=16, k=4, seed=0):
+    rs = np.random.RandomState(seed)
+    x = {"x": rs.randn(n, d).astype(np.float32)}
+    y = rs.randint(0, k, (n, 1)).astype(np.int32)
+    return x, y
+
+
+def _fit(ff, epochs=1, seed=0):
+    x, y = _data(seed=seed)
+    ff.fit(x, y, epochs=epochs, batch_size=8, shuffle=False,
+           verbose=False)
+    return ff
+
+
+def _flat(tree):
+    import jax.tree_util as jtu
+
+    return {jtu.keystr(p): np.asarray(v)
+            for p, v in jtu.tree_flatten_with_path(tree)[0]}
+
+
+def _plan(old, new):
+    from flexflow_tpu.analysis.transition import plan_model_transition
+
+    return plan_model_transition(old, new)
+
+
+def _verify(plan):
+    from flexflow_tpu.analysis.transition import verify_transition
+
+    return verify_transition(plan)
+
+
+@pytest.fixture(scope="module")
+def stage3_pair():
+    """One (dp=4 stage-3 trained) → (dp=2×tp=2 replicated) pair shared
+    by the fuzzer tests (mutations always act on a fresh plan build)."""
+    old = _fit(_mlp(mesh=DP4, argv=["--weight-update-sharding=stage3"]))
+    assert old._update_sharding.get("stage") == 3
+    new = _mlp(mesh=DP2_TP2)
+    return old, new
+
+
+# ================================================== plan + identity
+
+
+def test_clean_transition_verifies_and_prices(stage3_pair):
+    old, new = stage3_pair
+    plan = _plan(old, new)
+    res = _verify(plan)
+    assert res.ok, [str(f) for f in res.errors()]
+    assert res.by_code("transition_clean")  # the clean marker is emitted
+    assert res.passes_run == ["state_mapping", "transition_memory",
+                              "transfer_collectives",
+                              "migration_donation",
+                              "transfer_uniformity"]
+    assert plan.transfers and plan.predicted_s > 0
+    # stage-3 at-rest shards must record their gather path
+    sharded = [t for t in plan.transfers if t["update_sharded"]]
+    assert sharded
+    for t in sharded:
+        assert any(c["kind"] == "all_gather" for c in t["collectives"]), t
+
+
+def test_predicted_seconds_reproduce_from_json_alone(stage3_pair):
+    """The ffcheck-identity treatment: predicted_s recomputes from the
+    serialized per-transfer entries with nothing else in scope."""
+    from flexflow_tpu.analysis.transition import verify_transition_total
+
+    old, new = stage3_pair
+    plan = _plan(old, new)
+    section = json.loads(json.dumps(plan.to_json(analysis=_verify(plan))))
+    total = verify_transition_total(section)
+    want = section["predicted_s"]
+    assert abs(total - want) <= 1e-9 + 1e-6 * abs(want)
+    assert section["bytes_on_wire"]  # the per-axis wire accounting rides
+
+
+# ========================================= the six-class corruption fuzzer
+
+
+def test_fuzzer_dropped_weight_mapping(stage3_pair):
+    old, new = stage3_pair
+    plan = _plan(old, new)
+    victim = next(t for t in plan.transfers
+                  if "kernel" in t["key"] and "params" in t["key"])
+    plan.transfers.remove(victim)
+    plan.schedule_digest = __import__(
+        "flexflow_tpu.analysis.transition",
+        fromlist=["schedule_digest"]).schedule_digest(plan.transfers)
+    res = _verify(plan)
+    codes = {f.code for f in res.errors()}
+    # the dropped mapping orphans the SAME leaf on both sides — exactly
+    # the mapping-completeness classes, nothing else
+    assert codes == {"dropped_state", "unmapped_state"}, codes
+    assert any(victim["key"] == f.where
+               for f in res.by_code("dropped_state"))
+
+
+def test_fuzzer_dtype_change(stage3_pair):
+    old, new = stage3_pair
+    plan = _plan(old, new)
+    victim = next(t for t in plan.transfers if "kernel" in t["key"])
+    victim["dst_dtype"] = "bfloat16"
+    res = _verify(plan)
+    assert [f.code for f in res.errors()] == ["state_dtype_change"]
+    assert res.errors()[0].where == victim["key"]
+
+
+def test_fuzzer_stage3_without_gather_path(stage3_pair):
+    """A stage-3 at-rest shard re-placed replicated with the gather
+    collectives stripped from its transfer = the silent-corruption
+    class that used to re-place partial shards as whole values."""
+    old, new = stage3_pair
+    plan = _plan(old, new)
+    victim = next(t for t in plan.transfers if t["update_sharded"])
+    victim["collectives"] = [c for c in victim["collectives"]
+                             if c["kind"] != "all_gather"]
+    from flexflow_tpu.analysis.transition import schedule_digest
+
+    plan.schedule_digest = schedule_digest(plan.transfers)
+    res = _verify(plan)
+    assert [f.code for f in res.errors()] == ["missing_gather_path"]
+    f = res.errors()[0]
+    assert f.where == victim["key"]
+    assert f.details.get("update_sharded") is True
+
+
+def test_fuzzer_nonbijective_transfer_ring(stage3_pair, monkeypatch):
+    from flexflow_tpu.parallel import ops as par_ops
+
+    old, new = stage3_pair
+    plan = _plan(old, new)
+    good = par_ops.ring_permutation
+    monkeypatch.setattr(par_ops, "ring_permutation",
+                        lambda n: good(n)[:-1])
+    res = _verify(plan)
+    assert [f.code for f in res.errors()] == ["bad_transfer_permutation"]
+
+
+def test_fuzzer_overcap_transition_peak(stage3_pair):
+    old, new = stage3_pair
+    plan = _plan(old, new)
+    plan.hbm_cap_bytes = 64.0  # nothing fits in 64 bytes
+    res = _verify(plan)
+    assert [f.code for f in res.errors()] == ["transition_oom"]
+    d = res.errors()[0].details
+    assert d["peak_bytes"] > d["cap_bytes"]
+
+
+def test_same_mesh_axis_move_is_not_a_missing_gather():
+    """A same-mesh axis MOVE (sharded on dim 0 → dim 1) lowers to an
+    all_to_all, which unwinds the axis from its old dim — it must
+    verify clean, not read as a missing gather path."""
+    from flexflow_tpu.analysis.transition import (
+        LeafInfo, PlanSide, build_transition_plan, verify_transition,
+    )
+
+    def side(assignment):
+        s = PlanSide(axis_sizes={"data": 2}, on_device=True)
+        s.leaves["['params']['l']['w']"] = LeafInfo(
+            key="['params']['l']['w']", shape=(4, 4), dtype="float32",
+            assignment=assignment, topo_pos=0)
+        return s
+
+    plan = build_transition_plan(side((("data",), ())),
+                                 side(((), ("data",))))
+    moved = plan.transfers[0]
+    assert [c["kind"] for c in moved["collectives"]
+            if c["kind"] != "slice"] == ["all_to_all"]
+    res = verify_transition(plan)
+    assert res.ok, [str(f) for f in res.errors()]
+
+
+def test_fuzzer_kv_pool_block_size_mismatch():
+    """Synthetic serving sides (the fuzzer injects at the plan level,
+    like the ffcheck fuzzer mutates axis_assignment): same pool leaf,
+    different block geometry → exactly kv_pool_mismatch."""
+    from flexflow_tpu.analysis.transition import (
+        LeafInfo, PlanSide, build_transition_plan, verify_transition,
+    )
+
+    def side(block_size, blocks=8):
+        s = PlanSide(axis_sizes={"data": 2}, on_device=True,
+                     kv_block_size=block_size)
+        s.leaves["['state']['attn']['pool_k']"] = LeafInfo(
+            key="['state']['attn']['pool_k']",
+            shape=(blocks, block_size, 16), dtype="float32",
+            assignment=((), (), ()), kv_pool=True, topo_pos=0)
+        return s
+
+    clean = build_transition_plan(side(16), side(16))
+    assert verify_transition(clean).ok
+    plan = build_transition_plan(side(16), side(8))
+    res = verify_transition(plan)
+    assert set(f.code for f in res.errors()) == {"kv_pool_mismatch"}
+
+
+def test_fuzzer_schedule_divergence_and_order(stage3_pair):
+    """The two schedule-integrity classes: a corrupted digest no longer
+    re-derives; a swapped order departs from the topological schedule."""
+    from flexflow_tpu.analysis.transition import schedule_digest
+
+    old, new = stage3_pair
+    plan = _plan(old, new)
+    plan.schedule_digest = "0" * 16
+    res = _verify(plan)
+    assert [f.code for f in res.errors()] \
+        == ["transfer_schedule_divergence"]
+
+    plan = _plan(old, new)
+    a = next(t for t in plan.transfers if "fc1" in t["key"])
+    b = next(t for t in plan.transfers if "fc2" in t["key"])
+    a["order"], b["order"] = b["order"], a["order"]
+    plan.schedule_digest = schedule_digest(plan.transfers)
+    res = _verify(plan)
+    assert [f.code for f in res.errors()] \
+        == ["nontopological_transfer_order"]
+
+
+# ================================================= migrate_state apply
+
+
+@pytest.mark.parametrize("old_args,new_mesh,new_args", [
+    (("--weight-update-sharding=stage3",), DP2_TP2, ()),
+    ((), DP4_TP2, ("--weight-update-sharding=stage2",)),
+], ids=["stage3_dp4->off_dp2tp2", "off_dp4->stage2_dp4tp2"])
+def test_migrate_bit_exact_vs_checkpoint_restart(tmp_path, old_args,
+                                                 new_mesh, new_args):
+    """The acceptance property: in-process migration lands the SAME
+    bits as a checkpoint-restart of the same state, and the continued
+    trajectory stays bit-exact — across mesh factorization AND ZeRO
+    stage toggles, with Adam-free SGD-momentum slots in play."""
+    from flexflow_tpu.resilience import migrate_state
+
+    old = _fit(_mlp(mesh=DP4, argv=old_args))
+    old.save_checkpoint(str(tmp_path / "ck"))
+
+    ctrl = _mlp(mesh=new_mesh, argv=new_args)
+    ctrl.load_checkpoint(str(tmp_path / "ck"))
+    mig = _mlp(mesh=new_mesh, argv=new_args)
+    section = migrate_state(old, mig)
+    assert section["analysis"]["errors"] == 0
+    assert section["measured_s"] >= 0
+
+    for name, a, b in (("params", ctrl._params, mig._params),
+                       ("slots", ctrl._opt_slots, mig._opt_slots),
+                       ("counters", ctrl._counters, mig._counters)):
+        fa, fb = _flat(a), _flat(b)
+        assert fa.keys() == fb.keys()
+        for k in fa:
+            assert np.array_equal(fa[k], fb[k]), f"{name}{k}"
+    assert int(ctrl._step) == int(mig._step)
+
+    # every migrated leaf carries the NEW compile's sharding
+    import jax.tree_util as jtu
+
+    for _p, leaf in jtu.tree_flatten_with_path(mig._params)[0]:
+        assert leaf.sharding.mesh.shape == mig.mesh.shape
+
+    _fit(ctrl, seed=1)
+    _fit(mig, seed=1)
+    fa, fb = _flat(ctrl._params), _flat(mig._params)
+    for k in fa:
+        assert np.array_equal(fa[k], fb[k]), k
+
+
+def test_migrate_refuses_architecture_mismatch():
+    """A new model whose graph differs is an unverifiable mapping: the
+    gate raises PlanVerificationError NAMING the leaf and class before
+    any live state moves."""
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, SGDOptimizer,
+    )
+    from flexflow_tpu.analysis import PlanVerificationError
+    from flexflow_tpu.resilience import migrate_state
+
+    old = _fit(_mlp(mesh=DP4))
+    sys.argv = ["test"]
+    config = FFConfig()
+    config.mesh_axis_sizes = DP2_TP2
+    config.batch_size = 8
+    other = FFModel(config)
+    x = other.create_tensor((8, 16), name="x")
+    t = other.dense(x, 48, ActiMode.AC_MODE_RELU, name="fc1")  # 48 != 32
+    t = other.dense(t, 4, name="fc2")
+    t = other.softmax(t, name="sm")
+    other.compile(optimizer=SGDOptimizer(lr=0.05, momentum=0.9),
+                  loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    before = _flat(other._params)
+    with pytest.raises(PlanVerificationError,
+                       match="state_shape_change.*fc1"):
+        migrate_state(old, other)
+    after = _flat(other._params)
+    for k in before:  # no live state moved
+        assert np.array_equal(before[k], after[k])
+
+
+def test_migrate_report_carries_transition_section(tmp_path):
+    """strategy_report.json gains the `transition` section after a
+    migration, with the identity reproducing and run_doctor-compatible
+    analysis fields."""
+    from flexflow_tpu.analysis.transition import verify_transition_total
+    from flexflow_tpu.resilience import migrate_state
+
+    old = _fit(_mlp(mesh=DP4))
+    new = _mlp(mesh=DP2_TP2)
+    new.enable_telemetry(str(tmp_path / "tel"))
+    new.enable_diagnostics()
+    migrate_state(old, new)
+    with open(tmp_path / "tel" / "strategy_report.json") as f:
+        report = json.load(f)
+    t = report.get("transition")
+    assert t is not None and t["transfers"]
+    assert t["analysis"]["errors"] == 0
+    total = verify_transition_total(t)
+    assert abs(total - t["predicted_s"]) \
+        <= 1e-9 + 1e-6 * abs(t["predicted_s"])
+    assert t.get("measured_s") is not None
+
+
+# ============================================ restore verify-before-apply
+
+
+def _poison_leaf_dtype(root):
+    """Rewrite one committed checkpoint leaf as float16 (arrays.npz +
+    manifest dtype together, so load_checkpoint returns a VALID fp16
+    array — the drift the gate must catch against the fp32 template)."""
+    import os
+
+    from flexflow_tpu.resilience import latest_checkpoint
+
+    ckdir = latest_checkpoint(root)
+    with open(os.path.join(ckdir, "manifest.json")) as f:
+        manifest = json.load(f)
+    path = next(k for k in manifest["leaves"]
+                if "fc1" in k and "kernel" in k)
+    meta = manifest["leaves"][path]
+    npz = os.path.join(ckdir, "arrays.npz")
+    data = dict(np.load(npz))
+    data[meta["key"]] = data[meta["key"]].astype(np.float16)
+    meta["dtype"] = "float16"
+    np.savez(npz, **data)
+    with open(os.path.join(ckdir, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    return path
+
+
+def test_restore_gate_names_leaf_and_class(tmp_path):
+    """Corrupting a committed checkpoint's leaf dtype is refused with
+    the finding class + leaf name BEFORE any re-placement — the shape
+    crash / silent cast mid-restore it used to be."""
+    from flexflow_tpu.analysis import PlanVerificationError
+
+    ff = _fit(_mlp(mesh=DP4))
+    root = str(tmp_path / "ck")
+    ff.save_checkpoint(root)
+    leaf = _poison_leaf_dtype(root)
+
+    ff2 = _mlp(mesh=DP2_TP2)
+    with pytest.raises(PlanVerificationError,
+                       match="state_dtype_change") as ei:
+        ff2.load_checkpoint(root)
+    assert leaf in str(ei.value)  # names the exact leaf
+
+
+def test_restore_gate_no_verify_plan_downgrades(tmp_path):
+    """--no-verify-plan downgrades the gate to warnings (the historical
+    silent-cast behavior, now logged + recorded)."""
+    ff = _fit(_mlp(mesh=DP4))
+    root = str(tmp_path / "ck")
+    ff.save_checkpoint(root)
+    _poison_leaf_dtype(root)
+
+    ff2 = _mlp(mesh=DP2_TP2, argv=["--no-verify-plan"])
+    ff2.load_checkpoint(root)  # restores, casting as before
+    assert ff2._transition["analysis"]["errors"] >= 1
+    import jax
+
+    assert jax.numpy.asarray(ff2._params["fc1"]["kernel"]).dtype \
+        == np.float32
+
+
+@pytest.mark.parametrize("resume_mesh,resume_args", [
+    (DP8, ()),
+    (DP4_TP2, ()),
+    (DP2_PP4, ()),
+    (DP8, ("--weight-update-sharding=stage2",)),
+    (DP4, ("--weight-update-sharding=stage3",)),
+], ids=["dp8", "dp4tp2", "dp2pp4", "dp8-stage2", "dp4-stage3"])
+def test_clean_sweep_existing_resume_paths(tmp_path, resume_mesh,
+                                           resume_args):
+    """Every cross-mesh / stage-toggle elastic-resume shape the suite
+    exercises verifies with ZERO transition errors — the gate must
+    never refuse a restore that was always legal."""
+    ff = _fit(_mlp(mesh=DP8, batch=8))
+    root = str(tmp_path / "ck")
+    ff.save_checkpoint(root)
+    ff2 = _mlp(mesh=resume_mesh, argv=resume_args)
+    ff2.load_checkpoint(root)
+    t = ff2._transition
+    assert t is not None
+    assert t["analysis"]["errors"] == 0, t["analysis"]
+    assert t["src"]["plan_source"] == "checkpoint"
+    # a resumed fit continues cleanly on the new layout
+    _fit(ff2, seed=2)
+
+
+def test_transition_memory_donation_accounting(stage3_pair):
+    """The timeline's donation schedule: the scheduled peak is <= the
+    conservative both-layouts bound, and the two-keyed gate only errors
+    when even the schedule cannot fit."""
+    old, new = stage3_pair
+    plan = _plan(old, new)
+    res = _verify(plan)
+    info = res.by_code("transition_memory_timeline")
+    assert info
+    d = info[0].details
+    assert d["peak_bytes"] <= d["conservative_bytes"]
+    assert d["timeline"]
+    # cap between scheduled peak and conservative bound: donation makes
+    # it fit — must NOT error
+    plan2 = _plan(old, new)
+    plan2.hbm_cap_bytes = d["conservative_bytes"]
+    res2 = _verify(plan2)
+    assert res2.ok
+    assert not res2.by_code("transition_oom")
