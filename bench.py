@@ -581,6 +581,39 @@ def _ring_ag_microbench(n: int, rows: int = 4096, cols: int = 512,
     return out
 
 
+def _rules_leg() -> dict:
+    """Rule-registry pin (ffrules, analysis/rules.py): the content
+    fingerprint of the STATIC generated rule set (no bench leg builds a
+    graph exhibiting the data-driven families, so the static registry is
+    exactly what every leg's search rewrote with), plus the wall time of
+    the full five-pass verification sweep. Raises if the registry fails
+    verification — the caller records the failure as a payload-level
+    marker so a capture searched under unsound rules is never mistaken
+    for a clean one."""
+    from flexflow_tpu import FFConfig
+    from flexflow_tpu.analysis import rules as ffrules
+
+    sys.argv = [sys.argv[0]]
+    cfg = FFConfig()
+    mesh_sizes = {"data": 2, "model": 4, "dcn": 1, "seq": 1}
+    cfg.mesh_axis_sizes = tuple(mesh_sizes.values())
+    t0 = time.perf_counter()
+    res = ffrules.verify_registry(mesh_sizes, cfg)
+    wall = time.perf_counter() - t0
+    errs = res.errors()
+    if errs:
+        raise RuntimeError(
+            f"rule registry failed verification: "
+            f"{[str(f) for f in errs[:3]]}")
+    clean = res.by_code("rules_clean")[0]
+    return {
+        "fingerprint": clean.details["fingerprint"],
+        "rules": clean.details["rules"],
+        "scope": "static_registry",
+        "verify_wall_s": round(wall, 3),
+    }
+
+
 def _warmstart_legs() -> dict:
     """Cold-vs-warm time-to-first-step against one fresh --warmstart-dir
     (compile start → first optimizer step done — the restart latency the
@@ -1024,6 +1057,29 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
     except Exception as e:  # pragma: no cover - defensive
         print(f"bench: migration leg failed: {e}", file=sys.stderr)
 
+    # rule-registry leg (ffrules, BENCH hygiene): pin the substitution
+    # rule set the plans in this capture were searched under — the
+    # content fingerprint (the component that joins the warm-start plan
+    # address) plus the full five-pass verification wall time, so the
+    # next driver capture can tell "rules changed" from "cost model
+    # drifted" when a searched plan moves
+    rules_leg = None
+    try:
+        rules_leg = _rules_leg()
+        print(json.dumps({
+            "metric": "rules_verify_wall_s",
+            "value": rules_leg["verify_wall_s"],
+            "rules": rules_leg["rules"],
+            "fingerprint": rules_leg["fingerprint"][:16],
+            "unit": "s",
+        }))
+    except Exception as e:  # pragma: no cover - defensive
+        # the failure itself is recorded in the payload: a capture whose
+        # registry failed verification (or could not be fingerprinted)
+        # must never read as a clean capture
+        rules_leg = {"error": f"{type(e).__name__}: {e}"}
+        print(f"bench: rules leg failed: {e}", file=sys.stderr)
+
     # warm-start legs: cold-vs-warm time-to-first-step against one shared
     # --warmstart-dir (secondary line + archived in the primary payload)
     warmstart = None
@@ -1066,6 +1122,8 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
         payload["migration"] = migration
     if warmstart is not None:
         payload["warmstart"] = warmstart
+    if rules_leg is not None:
+        payload["rules"] = rules_leg
     if tokens_per_sec is None:
         # a physically impossible reading must never become the number of
         # record: emit null and fail so the driver records the fluke as a

@@ -43,6 +43,19 @@ restore path (resilience/reshard.py) and the in-process live migration
 (resilience/migrate.py), the gating half of live re-planning
 (ROADMAP item 2).
 
+A fourth layer, **ffrules** (rules.py), verifies the SUBSTITUTION RULES
+the search rewrites with (TASO/PET discipline, PAPERS.md "Substitution
+verification"): symbolic shape/dtype transfer on prime-valued dims,
+parallel-state soundness with a nonlinear probe on every mapped output,
+a semantic-equivalence oracle executing src and rewritten graphs
+fwd+bwd at dtype-ULP tolerance, boundary-precondition fuzz, and
+registry determinism (the `rules_fingerprint` that joins the warm-start
+plan address). External `--substitution-json` rules verify at LOAD
+(`RuleVerificationError`; `--no-verify-rules` downgrades); the
+`rule_verify` compile pass records the verdict + active rule-set
+fingerprint in the report, and `scripts/ffrules.py` sweeps the full
+generated registry in CI.
+
 Findings land in the `analysis` section of strategy_report.json
 (severity error/warning/info); errors abort compile unless
 `--no-verify-plan`. `scripts/fflint.py` runs the source-level hazard
@@ -62,6 +75,7 @@ from . import (
     lint,
     memory,
     numerics,
+    rules,
     sharding,
     sources,
     spmd,
@@ -78,15 +92,20 @@ from .findings import (
 
 __all__ = [
     "AnalysisContext", "AnalysisResult", "Finding",
-    "PlanVerificationError", "run_analysis", "verify_plan",
+    "PlanVerificationError", "RuleVerificationError", "run_analysis",
+    "verify_plan",
     "verify_strategy", "PASSES", "SEV_ERROR", "SEV_WARNING", "SEV_INFO",
-    "collectives", "donation", "lint", "memory", "numerics", "sharding",
-    "sources", "spmd", "transition",
+    "collectives", "donation", "lint", "memory", "numerics", "rules",
+    "sharding", "sources", "spmd", "transition",
 ]
 
 # (name, runner) in execution order; each runner is
 # fn(graph, mesh, ctx) -> list[Finding]. Passes 5 and 6 are the ffsan
-# layer (dtype-flow numerics + SPMD uniformity, ISSUE 10).
+# layer (dtype-flow numerics + SPMD uniformity, ISSUE 10); pass 7 is the
+# ffrules layer's compile-side hook (the heavy per-rule verification
+# runs at rule load time and in the scripts/ffrules.py CI sweep — the
+# compile pass surfaces the recorded load verdict + the active rule
+# set's fingerprint into the report).
 PASSES = (
     ("sharding_dataflow", sharding.run),
     ("memory_liveness", memory.run),
@@ -94,7 +113,10 @@ PASSES = (
     ("donation_aliasing", donation.run),
     ("dtype_flow", numerics.run),
     ("spmd_uniformity", spmd.run),
+    ("rule_verify", rules.run),
 )
+
+RuleVerificationError = rules.RuleVerificationError
 
 
 class AnalysisContext:
@@ -104,7 +126,7 @@ class AnalysisContext:
     def __init__(self, machine=None, cost_model=None, opt_slots: int = 1,
                  update_specs=None, training: bool = True,
                  hbm_cap_bytes: float = 0.0, config=None,
-                 update_stage: int = 0):
+                 update_stage: int = 0, plan_source: str = ""):
         self.machine = machine
         self.cost_model = cost_model
         self.opt_slots = opt_slots
@@ -119,6 +141,12 @@ class AnalysisContext:
         # mixed-precision policy (computation_dtype / tensor-op math)
         # from the same source the executor lowers
         self.config = config
+        # where the plan came from (search|cache|checkpoint|import|
+        # manual|default|broadcast — model._plan_source): the ffrules
+        # pass only stamps a rule-set fingerprint on plans a rewrite
+        # search (now, or the cached search with the same rule address)
+        # actually produced
+        self.plan_source = plan_source
 
 
 def run_analysis(graph, mesh, ctx: Optional[AnalysisContext] = None,
@@ -186,6 +214,7 @@ def context_for_model(model, cost_model=None) -> AnalysisContext:
                   == CompMode.COMP_MODE_TRAINING),
         hbm_cap_bytes=cap,
         config=model.config,
+        plan_source=getattr(model, "_plan_source", ""),
     )
 
 

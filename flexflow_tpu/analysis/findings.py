@@ -48,9 +48,18 @@ _SEVERITIES = (SEV_ERROR, SEV_WARNING, SEV_INFO)
 #                        nontopological_transfer_order,
 #                        migration_donation_hazard,
 #                        transfer_schedule_divergence, transition_clean
+#   rule verify (ffrules): rule_shape_mismatch, rule_dtype_mismatch,
+#                        rule_replica_dim_leak, rule_degree_violation,
+#                        rule_partial_sum_nonlinear,
+#                        rule_numeric_divergence, rule_matcher_unsound,
+#                        rule_verification_crash,
+#                        rule_registry_nondeterministic,
+#                        rule_uninstantiable, rule_unassignable,
+#                        rule_oracle_skipped, rules_clean,
+#                        rules_fingerprint
 #   lint (fflint rules): host_sync_in_loop, unsorted_dict_hash,
 #                        global_rng, time_in_trace,
-#                        unverified_transition
+#                        unverified_transition, unverified_rule_load
 
 
 @dataclass

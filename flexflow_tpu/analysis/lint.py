@@ -52,6 +52,15 @@ Every rule encodes a bug class a past PR fixed by hand:
   becomes a shape crash or silent corruption mid-restore — route
   through `migrate_state` / `verify_restore_transition` (a fresh-init
   placement at compile is not a transition: pragma it).
+- `unverified_rule_load` — a call that constructs or loads
+  `GraphXfer`s (`load_rule_collection` without the verifying `config=`
+  argument, `compile_pattern_rule`, `generate_all_pcg_xfers`) in a
+  function that never consults the ffrules verifier
+  (analysis/rules.py). Rules injected into the search unverified are
+  exactly how an unsound rewrite becomes a silently-wrong plan — the
+  r19 twin of `unverified_transition`; the built-in registry's own
+  load sites are pragma'd because scripts/ffrules.py sweeps the full
+  generated registry in CI.
 
 Suppression: a trailing `# fflint: ok` (optionally naming codes,
 `# fflint: ok host_sync_in_loop`) on the flagged line or its enclosing
@@ -74,7 +83,7 @@ PASS_NAME = "fflint"
 ALL_RULES = ("host_sync_in_loop", "unsorted_dict_hash", "global_rng",
              "time_in_trace", "coordinator_collective", "donated_reuse",
              "low_precision_accum", "host_divergent_branch",
-             "unverified_transition")
+             "unverified_transition", "unverified_rule_load")
 
 # identifiers whose presence in an `if` test marks the branch as a
 # telemetry/diagnostics gate (a gated fetch is the sanctioned pattern)
@@ -125,6 +134,15 @@ _TRANSITION_APPLIERS = {"place_update_sharded", "place_like",
 _TRANSITION_CHECKERS = {"verify_restore_transition", "verify_transition",
                         "gate_transition", "build_transition_plan",
                         "plan_model_transition", "migrate_state"}
+
+# GraphXfer construct/load surface (search/substitution.py) and the
+# ffrules checker entry points that gate it (analysis/rules.py) — a
+# function loading rules must also consult the verifier, or pass
+# config= to load_rule_collection (the loader then verifies internally)
+_RULE_LOADERS = {"load_rule_collection", "compile_pattern_rule",
+                 "generate_all_pcg_xfers"}
+_RULE_CHECKERS = {"verify_rule", "verify_rules", "verify_registry",
+                  "gate_loaded_rules", "RuleVerificationError"}
 
 # summing reductions the low-precision-accumulation rule watches
 # (order statistics — max/min/argmax — carry no accumulation error)
@@ -699,6 +717,53 @@ class _FileLint:
                 f"mid-restore; route through migrate_state / "
                 f"verify_restore_transition (fresh-init placement at "
                 f"compile is exempt: pragma it)")
+
+    # ------------------------------------ rule: unverified rule load
+
+    def rule_unverified_rule_load(self):
+        calls = [n for n in ast.walk(self.tree)
+                 if isinstance(n, ast.Call)
+                 and _last_ident(n.func) in _RULE_LOADERS]
+        if not calls:
+            return
+        gated_scopes: set[int] = set()
+        for node in ast.walk(self.tree):
+            ident = ""
+            if isinstance(node, ast.Name):
+                ident = node.id
+            elif isinstance(node, ast.Attribute):
+                ident = node.attr
+            if ident in _RULE_CHECKERS:
+                scope = self._enclosing_def(node)
+                gated_scopes.add(id(scope) if scope is not None else 0)
+        def _is_none(node) -> bool:
+            return isinstance(node, ast.Constant) and node.value is None
+
+        for call in calls:
+            callee = _last_ident(call.func)
+            if callee == "load_rule_collection":
+                # the loader verifies internally when handed a config
+                # (keyword or third positional) — that call IS the
+                # gate. A literal None is NOT a config: the loader
+                # skips verification for it.
+                gated = any(kw.arg == "config" and not _is_none(kw.value)
+                            for kw in call.keywords)
+                if len(call.args) >= 3 and not _is_none(call.args[2]):
+                    gated = True
+                if gated:
+                    continue
+            scope = self._enclosing_def(call)
+            sid = id(scope) if scope is not None else 0
+            if sid in gated_scopes:
+                continue
+            self._emit(
+                call, SEV_WARNING, "unverified_rule_load",
+                f"{callee}() constructs/loads GraphXfers outside an "
+                f"ffrules-verifier-consulting function — an unsound "
+                f"rule injected into the search becomes a silently "
+                f"wrong plan; pass config= to load_rule_collection or "
+                f"route through analysis.rules.verify_rules (the "
+                f"CI-swept built-in registry is exempt: pragma it)")
 
     # ---------------------------------------------------------------- run
 

@@ -196,6 +196,16 @@ class FFConfig:
     # section. --no-verify-plan is the escape hatch (findings downgrade
     # to logged warnings).
     verify_plan: bool = True
+    # ffrules substitution-rule verification (analysis/rules.py): every
+    # rule loaded from --substitution-json is verified at load — symbolic
+    # shape/dtype transfer, parallel-state soundness, the semantic-
+    # equivalence oracle, and boundary-precondition fuzz — before it can
+    # inject rewrites into the search; an unsound rule raises a
+    # structured RuleVerificationError naming the rule and finding
+    # class. --no-verify-rules downgrades refusals to logged warnings
+    # (the verdict still lands in strategy_report.json's analysis
+    # section).
+    verify_rules: bool = True
     # ffsan runtime half (flexflow_tpu/sanitize.py): instrument the
     # train/eval/decode step with per-op finiteness probes (forward
     # values AND backward cotangents) so a NaN/inf is attributed to the
@@ -441,6 +451,8 @@ class FFConfig:
                 self.pipeline_steps = int(val())
             elif a == "--no-verify-plan":
                 self.verify_plan = False
+            elif a == "--no-verify-rules":
+                self.verify_rules = False
             elif a == "--sanitize-numerics":
                 self.sanitize_numerics = True
             elif a == "--spmd-barrier":

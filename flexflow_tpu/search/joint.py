@@ -290,9 +290,15 @@ def joint_graph_optimize(
     cm = cost_model or CostModel(machine_model_for_mesh(mesh))
     if _xfers is None:
         if config.substitution_json_path:
-            _xfers = load_rule_collection(config.substitution_json_path, mesh)
+            # external rules verify at load (the ffrules gate,
+            # analysis/rules.py): an unsound JSON rule raises a
+            # structured RuleVerificationError before it can reach the
+            # search; --no-verify-rules downgrades to a warning
+            _xfers = load_rule_collection(config.substitution_json_path,
+                                          mesh, config=config)
         else:
-            _xfers = generate_all_pcg_xfers(mesh, config, graph)
+            # built-in registry: swept by scripts/ffrules.py in CI
+            _xfers = generate_all_pcg_xfers(mesh, config, graph)  # fflint: ok unverified_rule_load
     cache = _segment_cache if _segment_cache is not None else {}
     budget = config.search_budget or 16
     alpha = config.search_alpha

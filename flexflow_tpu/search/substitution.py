@@ -390,7 +390,14 @@ def propagate_parallel_state(graph: Graph):
                     f"{node.name} ({node.op_type.name}) is nonlinear and "
                     f"cannot consume a partial-sum replica dim: "
                     f"f(sum x_i) != sum f(x_i)")
-            out_shapes = [in_shapes[0]]
+            if node.op_type == OT.OP_CAST:
+                # a cast changes the VALUE dtype: the IR must carry the
+                # target dtype or the ffrules/ffsan dtype-transfer checks
+                # would see the stale input dtype
+                out_shapes = [ParallelTensorShape(in_shapes[0].dims,
+                                                  node.params.dtype)]
+            else:
+                out_shapes = [in_shapes[0]]
             out_partial = in_partial[0] if in_partial else False
         elif node.op_type in (OT.OP_EW_ADD, OT.OP_EW_SUB, OT.OP_EW_MUL,
                               OT.OP_EW_DIV, OT.OP_EW_MAX, OT.OP_EW_MIN):
@@ -897,7 +904,11 @@ def create_partition_add_combine(degree: int, axes: tuple = ()) -> GraphXfer:
                make_params=lambda m: RepartitionParams(0, degree, axes))
     rep2 = OpX(OT.OP_REPARTITION, (b,),
                make_params=lambda m: RepartitionParams(0, degree, axes))
-    add2 = OpX(OT.OP_EW_ADD, (rep1.outputs[0], rep2.outputs[0]))
+    # match_src is load-bearing: without it the rewritten add carries
+    # params=None and the executor's _binary_forward crashes at runtime
+    # (caught by the ffrules semantic oracle)
+    add2 = OpX(OT.OP_EW_ADD, (rep1.outputs[0], rep2.outputs[0]),
+               match_src=add1)
     comb = OpX(OT.OP_COMBINE, (add2.outputs[0],),
                make_params=lambda m: CombineParams(0, degree, axes))
     x.src_ops = [add1]
@@ -1210,6 +1221,12 @@ def generate_all_pcg_xfers(mesh, config, graph: Optional[Graph] = None
         add(create_partition_pool2d_combine(deg, axes))
         add(create_partition_concat_combine(deg, axes))
         add(create_partition_embedding_combine(deg, axes))
+    # stable, content-hashable emission order (ffrules pass 5, registry
+    # determinism): sorted by the name that encodes (family, degree, act,
+    # axes) — the dedup key above — so two processes, or two runs of one
+    # process, emit byte-identical rule sets and the registry fingerprint
+    # (analysis/rules.rules_fingerprint) is a real content address
+    xfers.sort(key=lambda x: x.name)
     return xfers
 
 
@@ -1297,12 +1314,20 @@ def compile_pattern_rule(rule: dict) -> GraphXfer:
 
     named_ops: dict[str, OpX] = {}
     for spec in rule.get("src", []):
+        if not isinstance(spec, dict) or "op" not in spec:
+            raise ValueError(
+                f"rule {x.name}: each src entry must be an object with "
+                f"an 'op' field, got {spec!r}")
         ot = _op_type_by_name(spec["op"])
         ins = tuple(resolve_input(r) for r in spec.get("inputs", []))
         cons = tuple(_make_constraint(c)
                      for c in spec.get("constraints", []))
         op = OpX(ot, ins, num_outputs=int(spec.get("num_outputs", 1)),
                  constraints=cons)
+        # the declarative constraint specs stay attached so the ffrules
+        # verifier (analysis/rules.py) can honor eq/mod hints when it
+        # synthesizes a concrete instance (closures are opaque)
+        op._constraint_specs = tuple(spec.get("constraints", []))
         x.src_ops.append(op)
         out = spec.get("out")
         if out:
@@ -1310,12 +1335,29 @@ def compile_pattern_rule(rule: dict) -> GraphXfer:
             tensors[out] = op.outputs[0]
 
     for spec in rule.get("dst", []):
+        if not isinstance(spec, dict) or "op" not in spec:
+            raise ValueError(
+                f"rule {x.name}: each dst entry must be an object with "
+                f"an 'op' field, got {spec!r}")
         ot = _op_type_by_name(spec["op"])
         ins = tuple(resolve_input(r) for r in spec.get("inputs", []))
         if ot in _PARALLEL_PARAMS:
             cls, fields = _PARALLEL_PARAMS[ot]
             params = spec.get("params", {})
-            args = [params[f] for f in fields]
+            missing = [f for f in fields if f not in params]
+            if missing:
+                raise ValueError(
+                    f"rule {x.name}: parallel dst op {spec['op']!r} "
+                    f"params missing field(s) {missing} (needs {fields})")
+            args = []
+            for f in fields:  # dim/degree are ints by schema — coerce
+                try:
+                    args.append(int(params[f]))
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"rule {x.name}: parallel dst op {spec['op']!r} "
+                        f"param {f!r} must be an integer, got "
+                        f"{params[f]!r}")
             op = OpX(ot, ins, make_params=lambda m, c=cls, a=tuple(args):
                      c(*a))
         elif "match" in spec:
@@ -1355,7 +1397,8 @@ def compile_pattern_rule(rule: dict) -> GraphXfer:
     return x
 
 
-def load_rule_collection(path: str, mesh) -> list[GraphXfer]:
+def load_rule_collection(path: str, mesh,
+                         config=None) -> list[GraphXfer]:
     """JSON rule loader wired to --substitution-json (reference
     substitution_loader.cc + substitutions/graph_subst_3_v2.json). Two rule
     forms, mixable in one file:
@@ -1369,13 +1412,28 @@ def load_rule_collection(path: str, mesh) -> list[GraphXfer]:
 
     `degree` defaults to the mesh's model-axis size. Unknown generators /
     ops / malformed patterns raise (matching the reference loader's
-    strictness)."""
+    strictness).
+
+    When `config` is given, every loaded rule is VERIFIED through the
+    ffrules passes (analysis/rules.py) before it can reach the search —
+    external rules are the trust boundary TASO formalized: an unsound
+    rule raises a structured RuleVerificationError naming the rule and
+    finding class; `--no-verify-rules` downgrades to a warning with the
+    verdict recorded in strategy_report.json's analysis section."""
     with open(path) as f:
         data = json.load(f)
+    if not isinstance(data, dict) or not isinstance(
+            data.get("rules", []), list):
+        raise ValueError(
+            f"{path}: substitution file must be an object with a "
+            f"'rules' list")
     sizes = dict(mesh.shape)
     default_deg = sizes.get(AXIS_MODEL, 1)
     xfers = []
     for rule in data.get("rules", []):
+        if not isinstance(rule, dict):
+            raise ValueError(
+                f"{path}: each rule must be an object, got {rule!r}")
         if "src" in rule or "dst" in rule:
             xfers.append(compile_pattern_rule(rule))
             continue
@@ -1396,6 +1454,10 @@ def load_rule_collection(path: str, mesh) -> list[GraphXfer]:
             kw["n"] = int(rule["n"])
         xfers.append(_GENERATORS[gen](int(rule.get("degree", default_deg)),
                                       **kw))
+    if config is not None:
+        from ..analysis.rules import gate_loaded_rules
+
+        gate_loaded_rules(xfers, mesh, config, path)
     return xfers
 
 
@@ -1496,9 +1558,12 @@ def graph_optimize(graph: Graph, mesh, config,
 
     cm = cm or CostModel(machine_model_for_mesh(mesh))
     if config.substitution_json_path:
-        xfers = load_rule_collection(config.substitution_json_path, mesh)
+        # external rules verify at load (ffrules gate via config=)
+        xfers = load_rule_collection(config.substitution_json_path, mesh,
+                                     config=config)
     else:
-        xfers = generate_all_pcg_xfers(mesh, config, graph)
+        # built-in registry: swept by scripts/ffrules.py in CI
+        xfers = generate_all_pcg_xfers(mesh, config, graph)  # fflint: ok unverified_rule_load
     budget = config.search_budget or 16
     best, _ = base_optimize(
         graph, mesh, cm, xfers, budget=budget, alpha=config.search_alpha,

@@ -147,17 +147,57 @@ def config_signature(config) -> dict:
     return sig
 
 
+def rules_signature(graph, mesh_axes: dict, config) -> str:
+    """Content fingerprint of the substitution rule set THIS compile's
+    search would rewrite with (ffrules pass 5, analysis/rules.py): the
+    generated registry for this (mesh, config, graph), or the loaded
+    --substitution-json rules. A changed/added/removed rule changes the
+    plan address, so a stale cached plan can never replay against a
+    different rule set — the `substitution_json` file digest alone only
+    covers EXTERNAL rule changes, not built-in generator changes. The
+    generator module's own source digest is folded in as the coarse
+    backstop: a closure-body edit (a constraint predicate, a
+    match-dependent make_params) changes rule SEMANTICS without
+    changing the serialized structure, and conservative-miss beats
+    replaying a plan searched under different semantics."""
+    from ..analysis.rules import rules_fingerprint
+    from ..search import substitution as _subs
+
+    class _MeshShim:
+        shape = {k: int(v) for k, v in mesh_axes.items()}
+
+    src_digest = _file_digest(getattr(_subs, "__file__", ""))
+    try:
+        if config.substitution_json_path:
+            # fingerprint only — verification happens at the search's own
+            # verifying load site (config= there is the gate)
+            xfers = _subs.load_rule_collection(  # fflint: ok unverified_rule_load
+                config.substitution_json_path, _MeshShim)
+        else:
+            xfers = _subs.generate_all_pcg_xfers(  # fflint: ok unverified_rule_load
+                _MeshShim, config, graph)
+        return f"{rules_fingerprint(xfers)}:{src_digest}"
+    except Exception as e:
+        # an unloadable rule file is its own distinct state (the compile
+        # would fail differently) — never crash the fingerprint
+        return f"unloadable:{type(e).__name__}:{src_digest}"
+
+
 def structural_fingerprint(graph, mesh_axes: dict, config,
                            opt_slots: int = 1, mfu: float = 0.4) -> str:
     """Measurement-free plan identity (see module docstring)."""
     return _sha({
-        "v": 1,
+        "v": 2,
         "graph": graph_signature(graph),
         "mesh": {k: int(v) for k, v in mesh_axes.items()},
         "config": config_signature(config),
         "device": device_signature(),
         "opt_slots": int(opt_slots),
         "mfu": repr(float(mfu)),
+        # the rule set the search would rewrite with is part of the
+        # plan's identity (ffrules pass 5): a changed registry must
+        # invalidate every cached plan searched under the old one
+        "rules": rules_signature(graph, mesh_axes, config),
     })
 
 

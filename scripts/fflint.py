@@ -22,6 +22,11 @@ analysis.md has the catalog):
                            (place_update_sharded / place_like /
                            restore_tree) in a function that never
                            consults the fftrans transition checker
+  unverified_rule_load     a GraphXfer construct/load call
+                           (load_rule_collection sans config=,
+                           compile_pattern_rule,
+                           generate_all_pcg_xfers) in a function that
+                           never consults the ffrules verifier
 
 Suppression: trailing `# fflint: ok [codes]` on the line or its `def`.
 

@@ -159,7 +159,7 @@ def test_fuzzer_clean_baseline(searched):
     assert res.passes_run == ["sharding_dataflow", "memory_liveness",
                               "collective_uniformity",
                               "donation_aliasing", "dtype_flow",
-                              "spmd_uniformity"]
+                              "spmd_uniformity", "rule_verify"]
 
 
 def test_fuzzer_axis_reuse(searched):
@@ -601,7 +601,7 @@ def test_report_carries_analysis_section(tmp_path):
     assert a["passes_run"] == ["sharding_dataflow", "memory_liveness",
                                "collective_uniformity",
                                "donation_aliasing", "dtype_flow",
-                               "spmd_uniformity"]
+                               "spmd_uniformity", "rule_verify"]
     assert any(f["code"] == "memory_timeline" for f in a["findings"])
 
 

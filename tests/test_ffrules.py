@@ -1,0 +1,532 @@
+"""ffrules substitution-rule verifier tests (analysis/rules.py).
+
+Covers: the full-registry sweep (all five passes clean on the CI mesh),
+registry determinism + the content fingerprint, the corruption self-test
+corpus (each unsound-rule class caught as exactly its class), the JSON
+load gate (structured refusal naming rule + class, --no-verify-rules
+downgrade, verdict in the compile report), the JSON loader's error
+paths, the rules component of the warm-start plan fingerprint, the
+`unverified_rule_load` lint rule, and the executor-crash regression the
+oracle caught in partition_add_combine.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+CI_MESH = {"data": 2, "model": 4, "dcn": 1, "seq": 1}
+
+
+def _mk_config(argv=()):
+    import sys
+
+    old = sys.argv
+    sys.argv = ["t", *argv]
+    try:
+        from flexflow_tpu import FFConfig
+
+        return FFConfig()
+    finally:
+        sys.argv = old
+
+
+# ------------------------------------------------------------- registry
+
+def test_registry_stable_sorted_deduped():
+    """Pass 5: two generator runs serialize identically, names are
+    sorted and unique, and the fingerprint is a stable content hash."""
+    from types import SimpleNamespace
+
+    from flexflow_tpu.analysis.rules import (
+        rules_fingerprint,
+        serialize_rule,
+    )
+    from flexflow_tpu.search.substitution import generate_all_pcg_xfers
+
+    config = _mk_config(["-b", "8"])
+    mesh = SimpleNamespace(shape=dict(CI_MESH))
+    a = generate_all_pcg_xfers(mesh, config)
+    b = generate_all_pcg_xfers(mesh, config)
+    sa = [json.dumps(serialize_rule(x), sort_keys=True) for x in a]
+    sb = [json.dumps(serialize_rule(x), sort_keys=True) for x in b]
+    assert sa == sb
+    names = [x.name for x in a]
+    assert names == sorted(names)
+    assert len(set(names)) == len(names)
+    assert rules_fingerprint(a) == rules_fingerprint(b)
+    # dropping any one rule changes the content address
+    assert rules_fingerprint(a[1:]) != rules_fingerprint(a)
+
+
+def test_full_registry_verifies_clean():
+    """The acceptance sweep: every generated rule for the CI mesh config
+    passes all per-rule passes (symbolic transfer, parallel state,
+    oracle, fuzz) with zero errors AND zero unverified-rule warnings."""
+    from flexflow_tpu.analysis.rules import verify_registry
+
+    config = _mk_config(["-b", "8"])
+    res = verify_registry(CI_MESH, config)
+    assert res.errors() == [], [str(f) for f in res.errors()]
+    assert res.warnings() == [], [str(f) for f in res.warnings()]
+    clean = res.by_code("rules_clean")
+    assert clean and clean[0].details["rules"] > 15
+    assert len(clean[0].details["fingerprint"]) == 64
+
+
+def test_moe_fusion_rule_verifies():
+    """The data-driven fuse_moe_trio family instantiates (Group_by ->
+    n Dense -> Aggregate), verifies structurally, and skips the oracle
+    with an explicit info finding (fresh Experts weights)."""
+    from flexflow_tpu.analysis.rules import verify_rule
+    from flexflow_tpu.search.substitution import create_fuse_moe_trio
+
+    findings = verify_rule(create_fuse_moe_trio(4), CI_MESH)
+    assert [f for f in findings if f.severity == "error"] == []
+    assert any(f.code == "rule_oracle_skipped" for f in findings)
+
+
+# ------------------------------------------------- corruption self-test
+
+def test_corruption_classes_each_caught_as_its_class():
+    """The >=6-class self-test corpus: every injected unsound rule is
+    caught, and the ONLY finding code emitted is its own class."""
+    from flexflow_tpu.analysis.rules import selftest_classes, verify_rule
+
+    corpus = selftest_classes()
+    assert len(corpus) >= 6
+    for klass, xfer, expect in corpus:
+        findings = verify_rule(xfer, CI_MESH)
+        codes = sorted({f.code for f in findings})
+        assert codes == [expect], (klass, codes)
+        assert all(f.severity == "error" for f in findings), klass
+
+
+def test_partial_sum_generalization_covers_whole_registry():
+    """The one-rule numerics test (test_partial_sum_through_nonlinear
+    _rejected) generalized: the verifier's nonlinear probe fires on ANY
+    rule whose mapped output carries partial sums."""
+    from flexflow_tpu.analysis.rules import selftest_classes, verify_rule
+
+    _, xfer, expect = next(
+        c for c in selftest_classes() if c[0] == "partial_sum_nonlinear")
+    findings = verify_rule(xfer, CI_MESH)
+    assert [f.code for f in findings] == [expect]
+
+
+# ------------------------------------------------------- JSON load gate
+
+_BAD_RULE = {
+    "name": "external_bad_activation",
+    "src": [{"op": "linear", "inputs": ["$0"], "out": "l1",
+             "constraints": [{"attr": "activation", "eq": "none"}]}],
+    "dst": [{"op": "linear", "inputs": ["$0"], "match": "l1",
+             "params_update": {"activation": "sigmoid"}, "out": "l2"}],
+    "map_outputs": [["l1", "l2"]],
+}
+
+_GOOD_RULES = {"rules": [
+    {"generator": "replicate_linear_combine", "degree": 2,
+     "activation": "none"},
+    {"generator": "linear_relu_merge"},
+]}
+
+
+def test_unsound_json_rule_refused_at_load(tmp_path):
+    from types import SimpleNamespace
+
+    from flexflow_tpu.analysis.rules import RuleVerificationError
+    from flexflow_tpu.search.substitution import load_rule_collection
+
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"rules": [_BAD_RULE]}))
+    config = _mk_config(["-b", "8"])
+    mesh = SimpleNamespace(shape=dict(CI_MESH))
+    with pytest.raises(RuleVerificationError) as ei:
+        load_rule_collection(str(p), mesh, config=config)
+    # structured refusal names the rule AND the finding class
+    assert "external_bad_activation" in str(ei.value)
+    assert "rule_numeric_divergence" in str(ei.value)
+    assert ei.value.result.errors()
+    # without config (fingerprint-only path) the loader stays permissive
+    assert len(load_rule_collection(str(p), mesh)) == 1
+
+
+def test_no_verify_rules_downgrades_and_records(tmp_path):
+    import os
+    from types import SimpleNamespace
+
+    from flexflow_tpu.analysis.rules import _LOAD_RESULTS
+    from flexflow_tpu.search.substitution import load_rule_collection
+
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"rules": [_BAD_RULE]}))
+    config = _mk_config(["-b", "8", "--no-verify-rules"])
+    assert config.verify_rules is False
+    mesh = SimpleNamespace(shape=dict(CI_MESH))
+    xfers = load_rule_collection(str(p), mesh, config=config)
+    assert len(xfers) == 1
+    recorded = _LOAD_RESULTS[os.path.abspath(str(p))]
+    assert recorded.errors()  # verdict recorded even though downgraded
+
+
+def test_compile_refuses_unsound_json_rule(tmp_path):
+    from flexflow_tpu import FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.analysis.rules import RuleVerificationError
+
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"rules": [_BAD_RULE]}))
+    config = _mk_config(["-b", "8", "--mesh", "2,2,1,1",
+                         "--substitution-json", str(p), "--budget", "4"])
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 32), name="rg_in")
+    ff.dense(x, 8, name="rg_fc")
+    with pytest.raises(RuleVerificationError):
+        ff.compile(optimizer=SGDOptimizer(lr=0.1),
+                   loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+
+def test_compile_gate_records_verdict_in_report(tmp_path):
+    """--no-verify-rules: the unsound rule loads, the compile completes,
+    and the downgraded verdict + rule-set fingerprint land in the
+    analysis section (strategy_report.json's source of truth)."""
+    from flexflow_tpu import FFModel, LossType, SGDOptimizer
+
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"rules": [_BAD_RULE]}))
+    config = _mk_config(["-b", "8", "--mesh", "2,2,1,1",
+                         "--substitution-json", str(p), "--budget", "4",
+                         "--no-verify-rules"])
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 32), name="rgd_in")
+    ff.dense(x, 8, name="rgd_fc")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    res = ff._analysis
+    assert "rule_verify" in res.passes_run
+    recorded = res.by_code("rule_numeric_divergence")
+    assert recorded and all(f.severity == "warning" for f in recorded)
+    fp = res.by_code("rules_fingerprint")
+    assert fp and fp[0].details["source"] == "json"
+
+
+def test_compile_clean_json_reports_fingerprint(tmp_path):
+    from flexflow_tpu import FFModel, LossType, SGDOptimizer
+
+    p = tmp_path / "good.json"
+    p.write_text(json.dumps(_GOOD_RULES))
+    config = _mk_config(["-b", "8", "--mesh", "2,2,1,1",
+                         "--substitution-json", str(p), "--budget", "4"])
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 32), name="rgc_in")
+    ff.dense(x, 8, name="rgc_fc")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    res = ff._analysis
+    assert res.by_code("rules_clean")
+    assert res.by_code("rules_fingerprint")
+    assert not res.errors()
+
+
+# -------------------------------------------------- loader error paths
+
+def test_loader_error_paths(tmp_path):
+    """A malformed rule file raises a clear ValueError naming the
+    problem — never a KeyError mid-search or silent corruption."""
+    from types import SimpleNamespace
+
+    from flexflow_tpu.search.substitution import load_rule_collection
+
+    mesh = SimpleNamespace(shape=dict(CI_MESH))
+
+    def load(payload):
+        p = tmp_path / "r.json"
+        p.write_text(json.dumps(payload))
+        return load_rule_collection(str(p), mesh)
+
+    # unknown op name
+    with pytest.raises(ValueError, match="unknown op type"):
+        load({"rules": [{"name": "r", "src": [{"op": "nope"}],
+                         "dst": [], "map_outputs": []}]})
+    # dangling TensorX input (references an undeclared op)
+    with pytest.raises(ValueError, match="references unknown op"):
+        load({"rules": [{"name": "r",
+                         "src": [{"op": "linear", "inputs": ["ghost"],
+                                  "out": "l1"}],
+                         "dst": [], "map_outputs": []}]})
+    # empty dst
+    with pytest.raises(ValueError, match="needs src ops, dst ops"):
+        load({"rules": [{"name": "r",
+                         "src": [{"op": "linear", "inputs": ["$0"],
+                                  "out": "l1"}],
+                         "dst": [], "map_outputs": [["l1", "l1"]]}]})
+    # parallel dst op missing a params field
+    with pytest.raises(ValueError, match="missing field"):
+        load({"rules": [{"name": "r",
+                         "src": [{"op": "linear", "inputs": ["$0"],
+                                  "out": "l1"}],
+                         "dst": [{"op": "repartition", "inputs": ["$0"],
+                                  "params": {"dim": 0}, "out": "p1"}],
+                         "map_outputs": [["l1", "p1"]]}]})
+    # dst compute op with neither match nor parallel params
+    with pytest.raises(ValueError, match="needs 'match'"):
+        load({"rules": [{"name": "r",
+                         "src": [{"op": "linear", "inputs": ["$0"],
+                                  "out": "l1"}],
+                         "dst": [{"op": "linear", "inputs": ["$0"],
+                                  "out": "l2"}],
+                         "map_outputs": [["l1", "l2"]]}]})
+    # map_outputs referencing an unknown op
+    with pytest.raises(ValueError, match="map_outputs references"):
+        load({"rules": [{"name": "r",
+                         "src": [{"op": "linear", "inputs": ["$0"],
+                                  "out": "l1"}],
+                         "dst": [{"op": "linear", "inputs": ["$0"],
+                                  "match": "l1", "out": "l2"}],
+                         "map_outputs": [["l1", "ghost"]]}]})
+    # a rule that is not an object
+    with pytest.raises(ValueError, match="must be an object"):
+        load({"rules": ["not-a-rule"]})
+    # the file's rules field is not a list
+    with pytest.raises(ValueError, match="'rules' list"):
+        load({"rules": {"generator": "linear_relu_merge"}})
+    # constraint without eq/mod
+    with pytest.raises(ValueError, match="'eq' or 'mod'"):
+        load({"rules": [{"name": "r",
+                         "src": [{"op": "linear", "inputs": ["$0"],
+                                  "out": "l1",
+                                  "constraints": [{"attr": "x"}]}],
+                         "dst": [{"op": "linear", "inputs": ["$0"],
+                                  "match": "l1", "out": "l2"}],
+                         "map_outputs": [["l1", "l2"]]}]})
+    # parallel param of the wrong type (a string degree would otherwise
+    # crash the shape transforms mid-verification/mid-search)
+    with pytest.raises(ValueError, match="must be an integer"):
+        load({"rules": [{"name": "r",
+                         "src": [{"op": "linear", "inputs": ["$0"],
+                                  "out": "l1"}],
+                         "dst": [{"op": "repartition", "inputs": ["$0"],
+                                  "params": {"dim": 0, "degree": "x"},
+                                  "out": "p1"}],
+                         "map_outputs": [["l1", "p1"]]}]})
+
+
+# ------------------------------------------------ plan fingerprint join
+
+def test_changed_rule_set_invalidates_plan_fingerprint(monkeypatch):
+    """The rules_fingerprint is a component of the structural plan
+    fingerprint: a changed built-in registry (new/removed/altered rule)
+    changes the plan address, so the warm-start plan cache misses and
+    re-searches instead of replaying a plan searched under stale rules."""
+    from flexflow_tpu import FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.search import substitution as S
+    from flexflow_tpu.warmstart.fingerprint import structural_fingerprint
+
+    config = _mk_config(["-b", "8", "--mesh", "2,2,1,1"])
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 32), name="fpr_in")
+    ff.dense(x, 8, name="fpr_fc")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    mesh_axes = {k: int(v) for k, v in ff.mesh.shape.items()}
+    before = structural_fingerprint(ff.graph, mesh_axes, config)
+    assert before == structural_fingerprint(ff.graph, mesh_axes, config)
+
+    real = S.generate_all_pcg_xfers
+
+    def altered(mesh, cfg, graph=None):
+        xfers = real(mesh, cfg, graph)
+        return xfers[:-1]  # one rule removed = a different rule set
+
+    monkeypatch.setattr(S, "generate_all_pcg_xfers", altered)
+    after = structural_fingerprint(ff.graph, mesh_axes, config)
+    assert after != before
+
+
+def test_json_rule_file_content_keys_fingerprint(tmp_path):
+    """--substitution-json compiles key the plan address by the LOADED
+    rule content too (rules component), not just the file digest."""
+    from flexflow_tpu.warmstart.fingerprint import rules_signature
+
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps(_GOOD_RULES))
+    config = _mk_config(["-b", "8", "--mesh", "2,2,1,1",
+                         "--substitution-json", str(p)])
+    a = rules_signature(None, CI_MESH, config)
+    p.write_text(json.dumps({"rules": _GOOD_RULES["rules"][:1]}))
+    b = rules_signature(None, CI_MESH, config)
+    assert a != b and not a.startswith("unloadable")
+    # an unloadable file is its own distinct state, never a crash
+    p.write_text("{broken")
+    assert rules_signature(None, CI_MESH, config).startswith("unloadable")
+
+
+# ----------------------------------------------------------- lint rule
+
+_UNGATED_SNIPPET = """
+def inject(path, mesh):
+    from flexflow_tpu.search.substitution import load_rule_collection
+    return load_rule_collection(path, mesh)
+"""
+
+_GATED_SNIPPET = """
+def inject(path, mesh, config):
+    from flexflow_tpu.search.substitution import load_rule_collection
+    return load_rule_collection(path, mesh, config=config)
+"""
+
+_CHECKER_SNIPPET = """
+def inject(mesh, config):
+    from flexflow_tpu.analysis.rules import verify_rules
+    from flexflow_tpu.search.substitution import generate_all_pcg_xfers
+    xfers = generate_all_pcg_xfers(mesh, config)
+    verify_rules(xfers, mesh)
+    return xfers
+"""
+
+_PRAGMA_SNIPPET = """
+def inject(mesh, config):
+    from flexflow_tpu.search.substitution import generate_all_pcg_xfers
+    return generate_all_pcg_xfers(mesh, config)  # fflint: ok unverified_rule_load
+"""
+
+_NONE_CONFIG_SNIPPET = """
+def inject(path, mesh):
+    from flexflow_tpu.search.substitution import load_rule_collection
+    return load_rule_collection(path, mesh, config=None)
+"""
+
+
+def test_lint_unverified_rule_load():
+    from flexflow_tpu.analysis import lint
+
+    def codes(src):
+        return [f.code for f in lint.lint_source(
+            src, "snippet.py", select=("unverified_rule_load",))]
+
+    assert codes(_UNGATED_SNIPPET) == ["unverified_rule_load"]
+    assert codes(_GATED_SNIPPET) == []       # config= IS the gate
+    assert codes(_CHECKER_SNIPPET) == []     # verifier consulted
+    assert codes(_PRAGMA_SNIPPET) == []      # explicit suppression
+    # a literal config=None loads UNVERIFIED — not a gate
+    assert codes(_NONE_CONFIG_SNIPPET) == ["unverified_rule_load"]
+
+
+def test_fflint_repo_clean_includes_rule_load():
+    """Tier-1 invariant: the repo itself carries no ungated rule-load
+    sites (the generators' own fixtures are pragma'd)."""
+    import os
+
+    from flexflow_tpu.analysis.lint import lint_paths
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = lint_paths(
+        [os.path.join(root, "flexflow_tpu"),
+         os.path.join(root, "scripts")],
+        select=("unverified_rule_load",))
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_crashing_rule_refused_structurally():
+    """A rule that makes verification itself crash is refused with the
+    structured rule_verification_crash error — never a raw traceback
+    through the load gate."""
+    from flexflow_tpu.analysis.rules import verify_rules
+
+    class _Broken:
+        name = "broken_rule"
+        # no src_ops/dst_ops/mapped_outputs — serialization/verification
+        # will raise AttributeError, the crash path
+
+    res = verify_rules([_Broken()], CI_MESH)
+    errs = res.errors()
+    assert errs and errs[0].code == "rule_verification_crash"
+    assert "broken_rule" in errs[0].where
+
+
+def test_rule_verify_pass_skips_manual_and_import_plans():
+    """The compile pass stamps no rules_fingerprint on plans no rewrite
+    search produced (manual/import), and does stamp budget-searched
+    compiles (no JSON, no --enable-substitutions needed)."""
+    from types import SimpleNamespace
+
+    from flexflow_tpu.analysis import rules as R
+
+    config = _mk_config(["-b", "8", "--budget", "6"])
+    mesh = SimpleNamespace(shape=dict(CI_MESH))
+    searched = SimpleNamespace(config=config, plan_source="search")
+    stamped = R.run(None, mesh, searched)
+    assert any(f.code == "rules_fingerprint"
+               and f.details["source"] == "generated" for f in stamped)
+    for src in ("manual", "import"):
+        ctx = SimpleNamespace(config=config, plan_source=src)
+        assert R.run(None, mesh, ctx) == []
+
+
+# ------------------------------------------------------- regressions
+
+def test_cast_propagates_target_dtype():
+    """propagate_parallel_state carries OP_CAST's target dtype (the
+    symbolic dtype-transfer pass depends on it)."""
+    from flexflow_tpu.fftype import DataType, OperatorType as OT
+    from flexflow_tpu.ops.shape_ops import CastParams
+    from flexflow_tpu.pcg.graph import Graph, OpNode
+    from flexflow_tpu.search.substitution import propagate_parallel_state
+    from flexflow_tpu.tensor import ParallelTensor, ParallelTensorShape
+
+    g = Graph()
+    inp = g.add_node(OpNode(OT.OP_INPUT, None, name="x"))
+    inp.outputs = [ParallelTensor(ParallelTensorShape.from_shape(
+        (8, 8), DataType.DT_FLOAT), name="x")]
+    cast = g.add_node(OpNode(OT.OP_CAST,
+                             CastParams(DataType.DT_BFLOAT16)))
+    g.add_edge(inp, cast, 0, 0)
+    propagate_parallel_state(g)
+    assert cast.outputs[0].dtype == DataType.DT_BFLOAT16
+
+
+def test_partition_add_combine_rewrite_executes():
+    """Regression for the bug the oracle caught: the rewritten add node
+    must inherit the matched node's params (match_src) — params=None
+    crashes the executor's _binary_forward at runtime."""
+    from flexflow_tpu import FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.fftype import OperatorType as OT
+    from flexflow_tpu.search.substitution import (
+        create_partition_add_combine,
+    )
+
+    config = _mk_config(["-b", "8", "--mesh", "2,1,1,1"])
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 32), name="par_in")
+    a = ff.dense(x, 32, name="par_fc1")
+    b = ff.dense(x, 32, name="par_fc2")
+    ff.add(a, b, name="par_add")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_IDENTITY)
+    xfer = create_partition_add_combine(2, ("data",))
+    matches = xfer.find_matches(ff.graph)
+    assert matches
+    ng = xfer.apply(ff.graph, matches[0])
+    add = next(n for n in ng.topo_order() if n.op_type == OT.OP_EW_ADD)
+    assert add.params is not None
+
+
+def test_oracle_executes_whole_registry_families():
+    """Spot-check the oracle end-to-end on the three structurally
+    distinct families: algebraic merge, column TP with Reduction, and
+    sample partition (fast subset of the scripts/ffrules.py sweep)."""
+    from flexflow_tpu.analysis.rules import _check_oracle, _dim_env
+    from flexflow_tpu.fftype import ActiMode
+    from flexflow_tpu.search.substitution import (
+        create_linear_relu_merge,
+        create_partition_softmax_combine,
+        create_replicate_attention_reduce,
+    )
+
+    for xfer in (create_linear_relu_merge(),
+                 create_replicate_attention_reduce(4, ("model",)),
+                 create_partition_softmax_combine(2, ("data",))):
+        findings = _check_oracle(xfer, _dim_env(4, "oracle"),
+                                 f"rule:{xfer.name}")
+        assert findings == [], (xfer.name,
+                                [str(f) for f in findings])
