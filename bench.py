@@ -760,6 +760,109 @@ def _migration_legs(cfg, on_tpu: bool) -> dict:
     }
 
 
+def _elastic_legs(cfg, on_tpu: bool) -> dict:
+    """ffelastic leg: the cost of staying live through a re-plan
+    (elastic/, docs/elastic.md). One dp=4 LM takes an injected 50x
+    drift perturbation mid-fit; the leg records how long the loop ran
+    on the stale plan (trigger latency), what the online re-search
+    cost, what the migration cost vs its fftrans prediction (the
+    fidelity ratio the payoff rule calibrates from), and how many
+    steps until the drift monitor read clean again (steps-to-recover)."""
+    import tempfile
+
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import build_transformer_lm
+
+    n_dev = jax.device_count()
+    if n_dev < 4:
+        return {"skipped": f"{n_dev} device(s) — no dp=4 elastic leg"}
+
+    saved_argv = list(sys.argv)
+    tdir = tempfile.mkdtemp(prefix="bench_elastic_")
+    try:
+        sys.argv = [sys.argv[0], "--telemetry-dir", tdir, "--diagnostics"]
+        config = FFConfig()
+        config.mesh_axis_sizes = (4, 1, 1, 1)
+        config.batch_size = 4
+        ff = FFModel(config)
+        build_transformer_lm(ff, cfg, batch_size=4)
+        ff.compile(optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+                   loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        rs = np.random.RandomState(0)
+        n = 24  # 6 steps/epoch
+        X = {"tokens": rs.randint(
+                0, cfg.vocab_size,
+                (n, cfg.sequence_length)).astype(np.int32),
+             "positions": np.tile(
+                 np.arange(cfg.sequence_length, dtype=np.int32), (n, 1))}
+        Y = rs.randint(0, cfg.vocab_size,
+                       (n, cfg.sequence_length, 1)).astype(np.int32)
+        ff.fit(X, Y, epochs=1, batch_size=4, shuffle=False, verbose=False)
+
+        ctrl = ff.enable_elastic(
+            cooldown_steps=0, horizon_steps=1000,
+            visible_devices_fn=lambda: jax.devices()[:4])
+        diag = ff.get_diagnostics()
+        # the injected perturbation: the monitor now reads every step
+        # as a 50x excursion over the plan's claimed makespan
+        diag.drift.set_prediction((ff._predicted_step_s or 1e-3) / 50)
+
+        step_times = []  # (step, device_time_s) during the elastic fit
+        orig_on_step = diag.on_step
+
+        def probe(rec):
+            orig_on_step(rec)
+            if ctrl.decisions:
+                # freeze after the first decision: the recovery window
+                # must not be polluted by a second re-plan
+                ctrl.cooldown_steps = 10_000
+            dev = rec.get("device_time_s")
+            if dev is not None:
+                step_times.append((int(rec.get("step", 0)), float(dev)))
+
+        diag.on_step = probe
+        ff.fit(X, Y, epochs=2, batch_size=4, shuffle=False, verbose=False)
+    finally:
+        sys.argv = saved_argv
+
+    drifts = [d for d in ctrl.decisions if d.get("trigger") == "drift"]
+    if not drifts:
+        return {"skipped": "no drift decision fired", "decisions": 0}
+    d0 = drifts[0]
+    # steps-to-recover: first post-decision step whose device time is
+    # back within 2x the pre-decision norm (the re-plan step itself
+    # carries the recompile+migration spike)
+    dstep = int(d0["step"])
+    pre = sorted(t for s, t in step_times if s <= dstep)
+    norm = pre[len(pre) // 2] if pre else None
+    rec_step = next((s for s, t in step_times
+                     if s > dstep and norm and t <= 2 * norm), None)
+    pred = d0.get("predicted_migration_s")
+    meas = d0.get("migration_measured_s")
+    return {
+        "decision": d0.get("decision"),
+        "decisions": len(ctrl.decisions),
+        # steps the loop ran on the stale plan between the advisory and
+        # the decision (the controller consumes at the next boundary)
+        "trigger_latency_steps": int(d0["step"])
+        - int(d0["advisory"]["step"]),
+        "research_s": round(d0.get("research_s") or 0.0, 6),
+        "migration_predicted_s": (None if pred is None
+                                  else round(pred, 6)),
+        "migration_measured_s": (None if meas is None
+                                 else round(meas, 6)),
+        "migration_measured_vs_predicted": (
+            round(meas / pred, 4)
+            if pred and meas and pred > 0 else None),
+        "steps_to_recover": (rec_step - dstep
+                             if rec_step is not None else None),
+        "lhs_s": d0.get("lhs_s"),
+        "rhs_s": d0.get("rhs_s"),
+    }
+
+
 def _serving_legs(cfg, on_tpu: bool) -> dict:
     """Serving legs: requests/s/chip + decode tokens/s/chip through the
     continuous-batching engine (serving/) — the ROADMAP's "millions of
@@ -1057,6 +1160,26 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
     except Exception as e:  # pragma: no cover - defensive
         print(f"bench: migration leg failed: {e}", file=sys.stderr)
 
+    # elastic leg (ffelastic): one injected-drift live re-plan — trigger
+    # latency, online re-search seconds, migration measured vs
+    # predicted, and steps-to-recover, as a secondary line + an
+    # `elastic` field in the primary payload
+    elastic = None
+    try:
+        elastic = _elastic_legs(cfg, on_tpu)
+        print(json.dumps({
+            "metric": "elastic_replan",
+            **{k: elastic[k] for k in
+               ("decision", "trigger_latency_steps", "research_s",
+                "migration_predicted_s", "migration_measured_s",
+                "migration_measured_vs_predicted", "steps_to_recover",
+                "skipped")
+               if k in elastic},
+            "unit": "s",
+        }))
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"bench: elastic leg failed: {e}", file=sys.stderr)
+
     # rule-registry leg (ffrules, BENCH hygiene): pin the substitution
     # rule set the plans in this capture were searched under — the
     # content fingerprint (the component that joins the warm-start plan
@@ -1120,6 +1243,8 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
         payload["serving"] = serving
     if migration is not None:
         payload["migration"] = migration
+    if elastic is not None:
+        payload["elastic"] = elastic
     if warmstart is not None:
         payload["warmstart"] = warmstart
     if rules_leg is not None:

@@ -142,7 +142,9 @@ class AnalysisContext:
         # from the same source the executor lowers
         self.config = config
         # where the plan came from (search|cache|checkpoint|import|
-        # manual|default|broadcast — model._plan_source): the ffrules
+        # manual|default|broadcast|replan — model._plan_source; replan
+        # is a live ffelastic re-plan whose underlying origin rides
+        # model._plan_origin): the ffrules
         # pass only stamps a rule-set fingerprint on plans a rewrite
         # search (now, or the cached search with the same rule address)
         # actually produced

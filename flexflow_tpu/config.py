@@ -158,6 +158,19 @@ class FFConfig:
     diagnostics: bool = False
     drift_threshold: float = 0.5
     health_abort_on: tuple[str, ...] = ()
+    # elastic re-planning (elastic/): the controller consumes drift
+    # advisories and visible-device capacity deltas during fit (and the
+    # serving step loop), re-searches online, and migrates in-process
+    # when predicted_migration_s × fidelity < benefit/step × horizon.
+    # cooldown spaces consecutive re-plan attempts (a capacity shrink
+    # bypasses it); horizon is the step count the payoff rule amortizes
+    # the migration over; dry-run decides + records but never migrates.
+    # Drift triggers additionally need --diagnostics (the monitor lives
+    # there); capacity triggers work with --elastic alone.
+    elastic: bool = False
+    replan_cooldown_steps: int = 50
+    replan_horizon_steps: int = 1000
+    elastic_dry_run: bool = False
     # pipelined execution engine (engine/): fit runs chunks of N train
     # steps as ONE donated lax.scan dispatch over batches prefetched by a
     # background thread; checkpoints/preemption land at chunk boundaries.
@@ -442,6 +455,14 @@ class FFConfig:
                 self.diagnostics = True
             elif a == "--drift-threshold":
                 self.drift_threshold = float(val())
+            elif a == "--elastic":
+                self.elastic = True
+            elif a == "--replan-cooldown-steps":
+                self.replan_cooldown_steps = int(val())
+            elif a == "--replan-horizon-steps":
+                self.replan_horizon_steps = int(val())
+            elif a == "--elastic-dry-run":
+                self.elastic_dry_run = True
             elif a == "--health-abort-on":
                 self.health_abort_on = tuple(
                     r.strip() for r in val().split(",") if r.strip())

@@ -72,6 +72,7 @@ def diagnose(directory: str) -> dict:
     checkpoints = by_kind.get("checkpoint", [])
     searches = by_kind.get("search", [])
     compiles = by_kind.get("compile", [])
+    replans = by_kind.get("replan", [])
 
     data_wait_frac = None
     if steps:
@@ -138,6 +139,7 @@ def diagnose(directory: str) -> dict:
         },
         "preempted": preempted,
         "resumed": resumed,
+        "replans": replans,
         "trace_spans": spans,
         "trace_dropped_events": dropped_events,
         "strategy_report": report,
@@ -196,6 +198,21 @@ def render(d: dict) -> str:
                 f"| {a.get('action', 'warn')} | {a.get('message')} |")
     else:
         lines.append("none")
+
+    if d["replans"]:
+        lines += ["", "## Elastic re-plans (ffelastic)", "",
+                  "| step | trigger | decision | pay-off lhs (ms) "
+                  "| rhs (ms) | migration (ms) |",
+                  "|---|---|---|---|---|---|"]
+        def _ms(v):
+            return f"{v * 1e3:.3f}" if v is not None else "—"
+
+        for r in d["replans"]:
+            lines.append(
+                f"| {r.get('step', '—')} | {r.get('trigger', '?')} "
+                f"| {r.get('decision', '?')} | {_ms(r.get('lhs_s'))} "
+                f"| {_ms(r.get('rhs_s'))} "
+                f"| {_ms(r.get('migration_measured_s'))} |")
 
     if d["drift"]:
         dr = d["drift"]

@@ -125,44 +125,59 @@ class DriftMonitor:
         return adv
 
 
+def recalibrate_model(model, top_k: int = 4) -> Optional[float]:
+    """Re-measure the plan's dominant ops on the local device
+    (CostModel.calibrate_graph, remeasure=True) and refresh the model's
+    predicted step makespan — the canonical drift response, shared by the
+    recompile hook (make_recalibration_state) and the elastic controller's
+    replan path. Persisting the refreshed readings into the warm-start DB
+    happens HERE and only here (coordinator-only), so however the
+    recalibration was triggered the entries land exactly once. Returns
+    the refreshed prediction, or None when the model carries no search
+    result to recalibrate."""
+    # warm-started runs (plan cache / checkpoint / broadcast) carry no
+    # search result; the explain report reconstructed an equivalent
+    # (UnitySearch, choice) for the ADOPTED plan — use it, so drift
+    # recalibration works exactly on the runs that reload persisted
+    # calibration entries
+    sr = (getattr(model, "_search_result", None)
+          or getattr(model, "_replay_search", None))
+    if sr is None:
+        return None
+    us, choice = sr
+    # remeasure: the monitor fired BECAUSE the cached measurements no
+    # longer describe the device — refresh them, don't skip them
+    us.cm.calibrate_graph(model.graph, top_k=top_k, remeasure=True)
+    us.cm._cache.clear()
+    warm = getattr(model, "_warmstart", None)
+    if warm is not None:
+        # persist the refreshed readings (coordinator-only inside
+        # save_from's caller contract): the stale DB entries were
+        # feeding the plan-cache fingerprint, so the next restart
+        # would otherwise reload them and re-fire drift forever
+        from ..distributed import is_coordinator
+
+        if is_coordinator():
+            warm.calibration_db.save_from(us.cm)
+    t, _ = us.evaluate(choice)
+    model._predicted_step_s = t
+    diag = getattr(model, "_diagnostics", None)
+    if diag is not None and diag.drift is not None:
+        diag.drift.set_prediction(t)
+    return t
+
+
 def make_recalibration_state(model, top_k: int = 4):
-    """A RecompileState whose alter() re-measures the plan's dominant ops
-    on the local device (CostModel.calibrate_graph) and refreshes the
-    model's predicted step makespan — the canonical drift response. Attach
-    it via DiagnosticsManager(..., recalibrate=True) or pass it to a
-    DriftMonitor directly."""
+    """A RecompileState whose alter() runs `recalibrate_model` — the
+    drift response when NO elastic controller is attached. Attach it via
+    DiagnosticsManager(..., recalibrate=True) or pass it to a
+    DriftMonitor directly. (With --elastic the controller consumes the
+    advisory instead and recalibrates inside its replan, so the manager
+    does not arm this hook — one excursion, one trigger.)"""
     from ..recompile import RecompileState
 
     def _alter(ff):
-        # warm-started runs (plan cache / checkpoint / broadcast) carry no
-        # search result; the explain report reconstructed an equivalent
-        # (UnitySearch, choice) for the ADOPTED plan — use it, so drift
-        # recalibration works exactly on the runs that reload persisted
-        # calibration entries
-        sr = (getattr(ff, "_search_result", None)
-              or getattr(ff, "_replay_search", None))
-        if sr is None:
-            return
-        us, choice = sr
-        # remeasure: the monitor fired BECAUSE the cached measurements no
-        # longer describe the device — refresh them, don't skip them
-        us.cm.calibrate_graph(ff.graph, top_k=top_k, remeasure=True)
-        us.cm._cache.clear()
-        warm = getattr(ff, "_warmstart", None)
-        if warm is not None:
-            # persist the refreshed readings (coordinator-only inside
-            # save_from's caller contract): the stale DB entries were
-            # feeding the plan-cache fingerprint, so the next restart
-            # would otherwise reload them and re-fire drift forever
-            from ..distributed import is_coordinator
-
-            if is_coordinator():
-                warm.calibration_db.save_from(us.cm)
-        t, _ = us.evaluate(choice)
-        ff._predicted_step_s = t
-        diag = getattr(ff, "_diagnostics", None)
-        if diag is not None and diag.drift is not None:
-            diag.drift.set_prediction(t)
+        recalibrate_model(ff, top_k=top_k)
 
     return RecompileState(trigger_func=lambda ff: True,
                           alter_func=_alter, ffmodel=model)

@@ -285,9 +285,10 @@ def build_strategy_report(model) -> dict:
         "kind": "strategy_report",
         "mode": mode,
         # where the applied plan came from (search|cache|checkpoint|
-        # import|manual|default|broadcast — warmstart/): a cache/
-        # checkpoint source means this compile ran ZERO search
-        # evaluations for it
+        # import|manual|default|broadcast — warmstart/ — or replan, a
+        # live ffelastic re-plan mid-run; _plan_origin then keeps the
+        # underlying source): a cache/checkpoint source means this
+        # compile ran ZERO search evaluations for it
         "plan_source": getattr(model, "_plan_source", "none"),
         "mesh_axes": {k: int(v) for k, v in
                       getattr(model.mesh, "shape", {}).items()},
@@ -341,6 +342,22 @@ def build_strategy_report(model) -> dict:
         # treatment), which is the datapoint the re-planner's pay-off
         # rule consumes
         report["transition"] = transition
+    origin = getattr(model, "_plan_origin", None)
+    if origin is not None:
+        report["plan_origin"] = origin
+    decisions = getattr(model, "_elastic_decisions", None)
+    if decisions:
+        # ffelastic (elastic/): every re-plan decision this run took,
+        # each carrying BOTH sides of the pay-off inequality
+        # (lhs = predicted_migration_s × fidelity_ratio,
+        #  rhs = benefit_s_per_step × horizon_steps) so run_doctor
+        # --check can reproduce the migrate/decline call from the
+        # report alone
+        report["elastic"] = {
+            "decisions": list(decisions),
+            "migrations": sum(1 for d in decisions
+                              if d.get("decision") == "migrated"),
+        }
     return report
 
 
@@ -381,6 +398,20 @@ def render_markdown(report: dict) -> str:
             + f", {wire / 2**20:.2f} MiB on wire — "
             f"{ta.get('errors', '?')} error(s), "
             f"{ta.get('warnings', '?')} warning(s)")
+    if report.get("elastic"):
+        e = report["elastic"]
+        decs = e.get("decisions", [])
+        lines.append(
+            f"- elastic (ffelastic): {len(decs)} re-plan decision(s), "
+            f"{e.get('migrations', 0)} migration(s)")
+        for d in decs:
+            side = ""
+            if d.get("lhs_s") is not None and d.get("rhs_s") is not None:
+                side = (f" — pay-off {d['lhs_s'] * 1e3:.3f} ms vs "
+                        f"{d['rhs_s'] * 1e3:.3f} ms")
+            lines.append(
+                f"  - step {d.get('step', '?')}: {d.get('trigger', '?')}"
+                f" → {d.get('decision', '?')}{side}")
     if report.get("update_sharding"):
         stage = report.get("update_stage", 2)
         lines.append(

@@ -45,6 +45,12 @@ class DiagnosticsManager:
         self._recalibrate = bool(recalibrate)
         self.drift: Optional[DriftMonitor] = None
         self.report: Optional[dict] = None
+        # elastic controller (elastic/controller.py), set by
+        # ElasticController.attach_diagnostics: when present it is the
+        # single consumer of drift advisories (on_step forwards them) and
+        # the monitor's own recompile hook stays disarmed — one sustained
+        # excursion, one trigger
+        self.elastic = None
 
     # ------------------------------------------------------------ compile
 
@@ -60,7 +66,7 @@ class DiagnosticsManager:
         predicted = self.report["total_predicted_s"]
         self.model._predicted_step_s = predicted
         rs = (make_recalibration_state(self.model)
-              if self._recalibrate else None)
+              if self._recalibrate and self.elastic is None else None)
         self.drift = DriftMonitor(predicted,
                                   threshold=self.drift_threshold,
                                   recompile_state=rs)
@@ -92,6 +98,8 @@ class DiagnosticsManager:
                 if adv is not None:
                     self._alerts.record("advisory", **adv.to_record())
                     fflog.warning("diagnostics: %s", adv.message)
+                    if self.elastic is not None:
+                        self.elastic.on_advisory(adv)
 
     def note_checkpoint_commit(self, t: Optional[float]):
         rule = self.health.rule("ckpt_stale")

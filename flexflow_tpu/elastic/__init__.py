@@ -1,0 +1,32 @@
+"""ffelastic: drift/capacity-triggered live re-planning (docs/elastic.md).
+
+The subsystem that turns the verification layers and the migration engine
+into behavior: an ElasticController wired into fit (and the serving
+engine's step loop) consumes DriftMonitor advisories and visible-device
+capacity deltas, re-runs the Unity search online against recalibrated
+measurements, gates the winner through the full compile-time verifier
+stack (plan_source "replan"), prices the move with fftrans, and fires
+migrate_state exactly when
+
+    predicted_migration_s x fidelity_ratio < benefit_s_per_step x horizon
+
+recording every decision (both sides of the inequality) as a `replan`
+telemetry event, an `elastic` strategy-report section, and run_doctor
+alerts.
+"""
+
+from .apply import PlanSnapshot, replan
+from .controller import ElasticController
+from .payoff import evaluate_payoff, load_fidelity, record_fidelity
+from .triggers import CapacityDelta, CapacityWatcher
+
+__all__ = [
+    "CapacityDelta",
+    "CapacityWatcher",
+    "ElasticController",
+    "PlanSnapshot",
+    "evaluate_payoff",
+    "load_fidelity",
+    "record_fidelity",
+    "replan",
+]

@@ -1,0 +1,165 @@
+"""ElasticController: drift/capacity-triggered live re-planning.
+
+Closes the elastic loop the previous subsystems left open (ROADMAP item
+1): the drift monitor (diagnostics/drift.py) detects when the cost model
+no longer describes the device, warm start (warmstart/) makes an online
+re-search cheap, and fftrans (analysis/transition.py +
+resilience/migrate.py) makes any plan→plan move verified, priced, and
+executable in-process — this controller decides WHEN to use them.
+Payoff-gated live reconfiguration follows Gemini (Wang et al., SOSP '23,
+PAPERS.md: reconfigure only when the modeled benefit over the remaining
+horizon exceeds the modeled cost of moving), with the re-search run as a
+fresh Unity joint optimization against recalibrated measurements (Unity,
+OSDI '22).
+
+Wiring: `FFModel.fit` calls `maybe_replan(step)` after each eager step
+(the pipelined engine calls it at chunk boundaries; the serving engine
+polls capacity between decode steps). Trigger streams:
+
+- drift: the DiagnosticsManager forwards DriftMonitor advisories here
+  (satellite dedupe: when a controller is attached the manager does NOT
+  arm the monitor's own recompile hook, so one sustained excursion
+  produces exactly one trigger — the monitor's re-arm at threshold/2
+  stays the single source of hysteresis);
+- capacity: CapacityWatcher compares the visible device set against the
+  compiled mesh.
+
+A step-count cooldown (`--replan-cooldown-steps`) spaces consecutive
+re-plan attempts so the loop never flaps; a capacity SHRINK bypasses it
+(the compiled mesh no longer physically exists). `--elastic-dry-run`
+runs the full trigger → search → gate → price pipeline and records the
+decision, but never migrates.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from ..telemetry import log as fflog
+from .apply import replan
+from .triggers import CapacityDelta, CapacityWatcher
+
+
+class ElasticController:
+    def __init__(self, model, diag=None, *,
+                 cooldown_steps: Optional[int] = None,
+                 horizon_steps: Optional[int] = None,
+                 dry_run: Optional[bool] = None,
+                 visible_devices_fn: Optional[Callable[[], Sequence]] = None,
+                 capacity_check_every: int = 8):
+        cfg = model.config
+        self.model = model
+        self.diag = None
+        self.cooldown_steps = int(
+            cfg.replan_cooldown_steps if cooldown_steps is None
+            else cooldown_steps)
+        self.horizon_steps = int(
+            cfg.replan_horizon_steps if horizon_steps is None
+            else horizon_steps)
+        self.dry_run = bool(
+            cfg.elastic_dry_run if dry_run is None else dry_run)
+        self.watcher = CapacityWatcher(
+            model, visible_devices_fn, check_every=capacity_check_every)
+        self._pending = None  # latest un-consumed DriftAdvisory
+        # cooldown anchor: the step of the last re-plan ATTEMPT (any
+        # outcome — a declined search is as expensive as a migrated one)
+        self._anchor_step = int(model._py_step()) if getattr(
+            model, "_compiled", False) else 0
+        if not hasattr(model, "_elastic_decisions"):
+            model._elastic_decisions = []
+        self.decisions = model._elastic_decisions
+        if diag is not None:
+            self.attach_diagnostics(diag)
+
+    # ------------------------------------------------------------ triggers
+
+    def attach_diagnostics(self, diag):
+        """Wire the drift stream: the manager forwards advisories here,
+        and the monitor's own recompile hook is disarmed so one excursion
+        yields one trigger (the controller replaces it as the drift
+        response; recalibration runs inside the replan instead)."""
+        self.diag = diag
+        diag.elastic = self
+        if diag.drift is not None:
+            diag.drift.recompile_state = None
+
+    def on_advisory(self, adv):
+        """One DriftAdvisory from the monitor (hysteresis already
+        applied there). Kept pending until the next maybe_replan call;
+        advisories landing inside the cooldown are dropped."""
+        if self._in_cooldown(int(adv.step)):
+            fflog.debug("elastic: drift advisory at step %d dropped "
+                        "(cooldown)", adv.step)
+            return
+        self._pending = adv
+
+    def _in_cooldown(self, step: int) -> bool:
+        return (step - self._anchor_step) < self.cooldown_steps
+
+    def _measured_ema(self) -> Optional[float]:
+        if self.diag is not None and self.diag.drift is not None:
+            return self.diag.drift.measured_ema
+        return None
+
+    # ------------------------------------------------------------ decide
+
+    def maybe_replan(self, step: int) -> bool:
+        """The fit-loop hook: consume pending triggers and re-plan when
+        warranted. Returns True when a migration happened (the caller's
+        captured step function is stale and must be rebuilt from
+        model.executor)."""
+        step = int(step)
+        adv, self._pending = self._pending, None
+        cap = self.watcher.check(step)
+        if cap is not None and cap.shrink:
+            # forced: devices vanished from under the compiled mesh —
+            # cooldown cannot apply, the old plan cannot run
+            return self._on_capacity(step, cap)
+        if self._in_cooldown(step):
+            return False
+        if cap is not None:
+            return self._on_capacity(step, cap)
+        if adv is not None:
+            return self._on_drift(step, adv)
+        return False
+
+    def _on_drift(self, step: int, adv) -> bool:
+        self._anchor_step = step
+        d = replan(
+            self.model, step=step, trigger="drift",
+            horizon_steps=self.horizon_steps,
+            measured_ema_s=adv.measured_ema_s, dry_run=self.dry_run,
+            extra={"advisory": adv.to_record()})
+        return d.get("decision") == "migrated"
+
+    def _on_capacity(self, step: int, cap: CapacityDelta) -> bool:
+        from .. import telemetry
+
+        self._anchor_step = step
+        if cap.new_axes is None:
+            # visible count undividable by the fixed mesh axes (or a
+            # multi-host mesh): record the decline — no search ran, so
+            # the record carries no payoff sides
+            decision = {
+                "step": step, "trigger": "capacity",
+                "decision": "declined", "dry_run": self.dry_run,
+                "capacity": cap.to_record(),
+                "reason": "no mesh factorization for visible device set",
+            }
+            self.decisions.append(decision)
+            telemetry.event("replan", **decision)
+            if self.diag is not None:
+                self.diag._alerts.record(
+                    "alert", rule="elastic_replan", level="warning",
+                    step=step, action="declined",
+                    message=(f"capacity delta ({cap.compiled} -> "
+                             f"{cap.visible} devices) but no mesh "
+                             f"factorization fits — staying put"))
+            return False
+        d = replan(
+            self.model, step=step, trigger="capacity",
+            horizon_steps=self.horizon_steps,
+            new_mesh_axes=cap.new_axes,
+            measured_ema_s=self._measured_ema(), dry_run=self.dry_run,
+            forced=cap.shrink, extra={"capacity": cap.to_record()})
+        return d.get("decision") == "migrated"

@@ -1,0 +1,105 @@
+"""Trigger streams for the elastic controller.
+
+Two triggers feed `ElasticController.maybe_replan`:
+
+- **drift** — sustained cost-model drift. The DriftMonitor already owns
+  the hysteresis (advisory once per excursion, re-arm at threshold/2);
+  the DiagnosticsManager forwards each advisory here instead of firing
+  its own recompile hook, so one excursion produces ONE trigger.
+- **capacity** — a delta between the visible device set and the compiled
+  mesh (chips preempted away, or restored). `CapacityWatcher` polls the
+  visible set (injectable for tests) every `check_every` controller
+  calls and proposes a new mesh factorization by rescaling the data
+  axis; a visible count the fixed model/pipe/seq axes cannot divide is
+  reported with `new_axes=None` so the controller records a declined
+  decision instead of compiling an impossible mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+
+@dataclass
+class CapacityDelta:
+    """One observed visible-vs-compiled device-set delta."""
+
+    step: int
+    visible: int            # devices visible now
+    compiled: int           # devices in the compiled mesh
+    new_axes: Optional[tuple]  # proposed mesh_axis_sizes (None: undividable)
+    shrink: bool            # visible < compiled → forced migration
+
+    def to_record(self) -> dict:
+        return {
+            "step": int(self.step), "visible": int(self.visible),
+            "compiled": int(self.compiled),
+            "new_axes": (list(self.new_axes)
+                         if self.new_axes is not None else None),
+            "shrink": bool(self.shrink),
+        }
+
+
+class CapacityWatcher:
+    """Detects grow/shrink of the visible device set vs the compiled
+    mesh. Stateless between checks except the poll cadence — the
+    controller's cooldown owns anti-flap pacing for grows (a shrink is
+    forced: the compiled mesh no longer physically exists)."""
+
+    def __init__(self, model,
+                 visible_devices_fn: Optional[Callable[[], Sequence]] = None,
+                 check_every: int = 8):
+        import jax
+
+        self.model = model
+        self._visible_fn = visible_devices_fn or jax.devices
+        self.check_every = max(1, int(check_every))
+        self._calls = 0
+
+    def propose_axes(self, visible: int) -> Optional[tuple]:
+        """mesh_axis_sizes for `visible` devices: rescale the data axis,
+        keep every other axis fixed. None when the fixed axes don't
+        divide the visible count (or the mesh is multi-host — capacity
+        moves are single-controller scope, like serving)."""
+        from ..machine import AXIS_DATA
+
+        cfg = self.model.config
+        if getattr(cfg, "num_nodes", 1) > 1:
+            return None
+        ms = cfg.mesh_shape()
+        # the COMPILED mesh's sizes, in the config's axis order (a
+        # mesh-shape search may have replaced the configured sizes)
+        compiled = dict(self.model.mesh.shape)
+        sizes = [int(compiled.get(a, s))
+                 for a, s in zip(ms.axis_names, ms.axis_sizes)]
+        if AXIS_DATA not in ms.axis_names:
+            return None
+        di = ms.axis_names.index(AXIS_DATA)
+        fixed = 1
+        for i, s in enumerate(sizes):
+            if i != di:
+                fixed *= s
+        if visible < fixed or visible % fixed:
+            return None
+        sizes[di] = visible // fixed
+        return tuple(sizes)
+
+    def check(self, step: int) -> Optional[CapacityDelta]:
+        """Poll the visible device set (every check_every-th call);
+        returns a CapacityDelta when it no longer matches the compiled
+        mesh."""
+        self._calls += 1
+        if (self._calls - 1) % self.check_every:
+            return None
+        try:
+            visible = len(self._visible_fn())
+        except Exception:
+            return None
+        compiled = int(self.model.mesh.devices.size)
+        if visible == compiled:
+            return None
+        return CapacityDelta(
+            step=int(step), visible=visible, compiled=compiled,
+            new_axes=self.propose_axes(visible),
+            shrink=visible < compiled)

@@ -75,8 +75,21 @@ class PipelinedEngine:
         # the default device). Leading None is the chunk's scan axis —
         # batches stack along it unsharded.
         self._input_shardings: dict = {}
+        self._mesh = model.mesh
         self._label_sharding = NamedSharding(
             model.mesh, PartitionSpec(None, *model.label_spec))
+
+    def _sync_mesh(self):
+        """Rebuild the cached shardings when an elastic re-plan swapped
+        the model's mesh (staged inputs place onto the mesh the NEXT
+        chunk's executable runs on, which is no longer the one these
+        caches were resolved against)."""
+        if self.model.mesh is not self._mesh:
+            self._mesh = self.model.mesh
+            self._input_shardings.clear()
+            self._label_sharding = NamedSharding(
+                self._mesh,
+                PartitionSpec(None, *self.model.label_spec))
 
     def _sharding_for(self, name: str) -> NamedSharding:
         sh = self._input_shardings.get(name)
@@ -118,21 +131,24 @@ class PipelinedEngine:
         SimulatedPreemption propagate to fit's handlers; the prefetch
         thread is shut down on every exit path."""
         model = self.model
+        self._sync_mesh()  # an elastic re-plan may have swapped the mesh
         chunks = plan_chunks(b0, num_batches, self.pipeline_steps)
         if not chunks:
             return py_step, False
+        stage = (lambda c: self._stage_chunk(
+            x_dict, y, order, c[0], c[1], batch_size))
         prefetcher = ChunkPrefetcher(
-            lambda c: self._stage_chunk(
-                x_dict, y, order, c[0], c[1], batch_size),
-            chunks, depth=self.prefetch_depth)
+            stage, chunks, depth=self.prefetch_depth)
         # the loss vector is fetched once per chunk only when something
         # consumes it (telemetry timing sync + diagnostics rules, both
         # synthesized under tel); a bare fit dispatches chunks
         # back-to-back with no host sync at all
         need_losses = tel is not None
         preempted = False
+        pending = list(chunks)
         try:
-            for start_b, n in chunks:
+            while pending:
+                start_b, n = pending[0]
                 t_chunk0 = time.perf_counter()
                 staged = prefetcher.get()
                 t_pop1 = time.perf_counter()
@@ -192,6 +208,20 @@ class PipelinedEngine:
                 if fault_hook is not None:
                     for s in range(py_step - n + 1, py_step + 1):
                         fault_hook(s)
+                pending.pop(0)
+                elastic = getattr(model, "_elastic", None)
+                if (elastic is not None and not preempted
+                        and elastic.maybe_replan(py_step) and pending):
+                    # the re-plan migrated executor + state at this
+                    # chunk edge: chunks already staged on the OLD mesh
+                    # are stale, so rebuild the prefetch pipeline over
+                    # the remaining chunks with the new mesh's
+                    # shardings (chunk_fn is re-fetched per chunk above,
+                    # so the executable swap needs nothing here)
+                    prefetcher.shutdown()
+                    self._sync_mesh()
+                    prefetcher = ChunkPrefetcher(
+                        stage, list(pending), depth=self.prefetch_depth)
                 if preempted:
                     telemetry.event("preempted", step=py_step)
                     return py_step, True

@@ -142,6 +142,13 @@ class FFModel:
         # None when --spmd-barrier is off) — recorded at compile,
         # surfaced in the compile metrics record + strategy_report.json
         self._spmd_barrier = None
+        # elastic re-planning (elastic/): the controller (--elastic /
+        # enable_elastic) and its decision records — every replan attempt
+        # (migrated/declined/dry_run/failed, both sides of the payoff
+        # inequality) appends here and rides strategy_report.json's
+        # `elastic` section
+        self._elastic = None
+        self._elastic_decisions = []
 
     # ================================================== tensor creation
 
@@ -758,6 +765,9 @@ class FFModel:
         self.optimizer = optimizer or SGDOptimizer(lr=self.config.learning_rate)
         self.loss_type = LossType(loss_type)
         self.metrics = Metrics.from_list(self.loss_type, list(metrics))
+        # the raw metrics argument, kept so an elastic replan can drive
+        # this same compile pipeline again with identical arguments
+        self._metrics_arg = tuple(metrics)
         self.config.computation_mode = comp_mode
 
         # --- create_operators_from_layers
@@ -1108,6 +1118,16 @@ class FFModel:
             if self._plan_source == "none":
                 self._plan_source = "default"  # data-parallel fallback
             self._assign_strategy()
+        hint = getattr(self, "_plan_source_hint", None)
+        if hint is not None:
+            # elastic replan: the recompile's outcome is relabeled so
+            # every consumer (plan record, compile event, report,
+            # ffcheck context) sees plan_source "replan"; the underlying
+            # origin (search/cache/broadcast/...) is kept for the
+            # decision record
+            self._plan_origin = self._plan_source
+            self._plan_source = hint
+            self._plan_source_hint = None
         if self._plan_fingerprint is not None:
             # manifest-ready plan record: every checkpoint this model
             # writes carries the applied plan + its structural
@@ -1503,6 +1523,33 @@ class FFModel:
             return None
         return self.enable_diagnostics()
 
+    def enable_elastic(self, **kwargs):
+        """Attach the elastic re-planning controller (elastic/) to this
+        model — the programmatic twin of --elastic. kwargs pass through
+        to ElasticController (cooldown_steps, horizon_steps, dry_run,
+        visible_devices_fn for tests). Reuses/attaches diagnostics when
+        configured so the drift trigger stream is live."""
+        from .elastic import ElasticController
+
+        diag = self._maybe_enable_diagnostics()
+        self._elastic = ElasticController(self, diag, **kwargs)
+        return self._elastic
+
+    def _maybe_enable_elastic(self, diag):
+        """Config-driven lazy attach (--elastic), mirroring the
+        diagnostics lazy attach; an existing controller (enable_elastic)
+        is reused, picking up diagnostics if it attached later."""
+        if self._elastic is not None:
+            if diag is not None and self._elastic.diag is None:
+                self._elastic.attach_diagnostics(diag)
+            return self._elastic
+        if not self.config.elastic:
+            return None
+        from .elastic import ElasticController
+
+        self._elastic = ElasticController(self, diag)
+        return self._elastic
+
     def _py_step(self) -> int:
         """The device step counter as a host int — THE checkpoint step
         numbering convention (fit's policy decisions, explicit saves, and
@@ -1605,6 +1652,7 @@ class FFModel:
             # callback, manual enable): write the explain report and arm
             # the drift monitor now
             diag.on_compile()
+        elastic = self._maybe_enable_elastic(diag)
         epoch_log = fflog.info if verbose else fflog.debug
         if self.config.profiling and not getattr(self, "_profiled", False):
             # --profiling: per-op kernel table, printed once per compile
@@ -1680,6 +1728,14 @@ class FFModel:
                         "resume", path=path, epoch=abs_epoch,
                         batch=int(cur.get("batch", 0)))
         py_step = self._py_step()
+        if elastic is not None and elastic.maybe_replan(py_step):
+            # fit-entry capacity check: a preempted/restored fleet
+            # re-plans BEFORE the first step so the whole epoch runs on
+            # the new mesh (the pipelined engine re-reads the model's
+            # executor/mesh per chunk; the eager step_fn is rebuilt here)
+            if engine is None:
+                step_fn = (self.executor._train_step
+                           or self.executor.build_train_step())
         # derived token rate: labels shaped (N, seq, ...) carry seq tokens
         # per example (trailing size-1 dims collapse; plain (N, 1) labels
         # degenerate to 1 token = 1 example)
@@ -1853,6 +1909,13 @@ class FFModel:
                                     diag.on_step(rec)
                         if self._fault_hook is not None:
                             self._fault_hook(py_step)
+                        if (elastic is not None and not preempted
+                                and elastic.maybe_replan(py_step)):
+                            # the re-plan migrated executor + state in
+                            # place at this step boundary — the captured
+                            # step callable belongs to the old executor
+                            step_fn = (self.executor._train_step
+                                       or self.executor.build_train_step())
                         if preempted:
                             telemetry.event("preempted", step=py_step)
                             fflog.warning(

@@ -138,6 +138,14 @@ def _migrate_impl(old, new, *, plan, donate: bool) -> dict:
         old._compiled = False  # the old model's state buffers are dead
 
     plan_json["measured_s"] = measured_s
+    if plan.predicted_s > 0 and measured_s > 0:
+        # fidelity datapoint for the elastic payoff rule: fold this
+        # migration's measured/predicted ratio into the per-device-kind
+        # calibration entry (elastic/payoff.py — persisted via the
+        # warm-start DB so it survives restarts)
+        from ..elastic.payoff import record_fidelity
+
+        record_fidelity(new, measured_s / plan.predicted_s)
     new._transition = plan_json
     telemetry.event(
         "migrate", predicted_s=plan.predicted_s, measured_s=measured_s,

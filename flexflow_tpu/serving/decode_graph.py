@@ -72,6 +72,9 @@ def _decode_config(model, spec: ServingSpec):
     cfg.telemetry_dir = ""
     cfg.xprof_dir = ""
     cfg.diagnostics = False
+    # the decode model must not grow its own controller — the ENGINE
+    # owns decode-mesh elasticity (ServingEngine.replan_mesh)
+    cfg.elastic = False
     cfg.checkpoint_dir = ""
     cfg.auto_resume = False
     cfg.pipeline_steps = 1

@@ -160,6 +160,40 @@ class ServingEngine:
         self._prefill_tokens = 0
         self._prefill_calls = 0
         self._device_s = 0.0
+        # elastic decode-mesh scaling (--elastic): poll the visible
+        # device set between steps and grow/shrink the decode mesh via
+        # replan_mesh; in-flight requests ride through untouched
+        self._capacity_watcher = None
+        self._steps_since_capacity_check = 0
+        self.replan_decisions: list[dict] = []
+        if getattr(cfg, "elastic", False):
+            self.enable_autoscale()
+
+    def enable_autoscale(self, visible_devices_fn=None,
+                         check_every: int = 16):
+        """Arm between-steps capacity watching on the decode mesh: when
+        the visible device set no longer matches it, the engine re-plans
+        to the factorization CapacityWatcher proposes (grow or shrink).
+        `visible_devices_fn` is injectable for tests."""
+        from ..elastic import CapacityWatcher
+
+        self._capacity_watcher = CapacityWatcher(
+            self.decode_model, visible_devices_fn,
+            check_every=max(1, int(check_every)))
+        return self._capacity_watcher
+
+    def _maybe_autoscale(self):
+        """step() preamble: consume one capacity delta if the watcher
+        sees one. Runs OUTSIDE the per-token device call — a re-plan
+        happens between scheduler iterations, never inside one."""
+        w = self._capacity_watcher
+        if w is None:
+            return
+        self._steps_since_capacity_check += 1
+        delta = w.check(self._steps_since_capacity_check)
+        if delta is None or delta.new_axes is None:
+            return
+        self.replan_mesh(delta.new_axes, trigger="capacity")
 
     # ------------------------------------------------------------ session
 
@@ -179,6 +213,71 @@ class ServingEngine:
             yield
         finally:
             telemetry.deactivate(tel)
+
+    # ------------------------------------------------------------ replan
+
+    def replan_mesh(self, mesh_axis_sizes, trigger: str = "manual") -> dict:
+        """Grow/shrink the decode mesh between scheduler iterations: a
+        fresh decode compile at the new factorization (warm-start cache
+        consulted, full verifier gate) followed by a verified, priced
+        `migrate_state` of the live decode state — params AND the KV
+        pools, whose global geometry is mesh-invariant (resolve_pool_
+        blocks keys off the TRAINER's mesh), so every in-flight slot's
+        cache rows move bit-exactly. The scheduler, block manager, page
+        tables, and RNG are host-side and untouched — in-flight token
+        streams continue exactly where they were. Returns the decision
+        record (also in `self.replan_decisions` and the `replan`
+        telemetry event stream)."""
+        import copy as _copy
+
+        from ..resilience.migrate import migrate_state
+
+        axes = tuple(int(s) for s in mesh_axis_sizes)
+        old_dec = self.decode_model
+        with self._active():
+            t0 = time.perf_counter()
+            decision = {
+                "trigger": str(trigger), "scope": "serving",
+                "old_mesh_axes": {k: int(v)
+                                  for k, v in old_dec.mesh.shape.items()},
+                "new_axes": list(axes),
+            }
+            spec2 = _copy.copy(self.spec)
+            spec2.config_overrides = dict(self.spec.config_overrides or {})
+            spec2.config_overrides["mesh_axis_sizes"] = axes
+            try:
+                with telemetry.span("serve.replan", trigger=trigger):
+                    new_dec, max_seq = build_decode_model(self.model, spec2)
+                    decision["research_s"] = time.perf_counter() - t0
+                    migrate_state(old_dec, new_dec)
+            except Exception as e:
+                decision["decision"] = "failed"
+                decision["error"] = f"{type(e).__name__}: {e}"
+                telemetry.event("replan", **decision)
+                self.replan_decisions.append(decision)
+                raise
+            # swap the device surface; everything host-side (scheduler,
+            # slots, block manager, stats) carries over untouched
+            self.decode_model = new_dec
+            self.max_seq_len = max_seq
+            self._step_fn = new_dec.executor.build_decode_step()
+            if self.block_manager is not None:
+                self._copy_fn = new_dec.executor.build_block_copy()
+            self.num_chips = int(new_dec.mesh.devices.size)
+            trans = new_dec._transition or {}
+            decision.update({
+                "decision": "migrated",
+                "new_mesh_axes": {k: int(v)
+                                  for k, v in new_dec.mesh.shape.items()},
+                "predicted_migration_s": trans.get("predicted_s"),
+                "migration_measured_s": trans.get("measured_s"),
+                "plan_origin": getattr(new_dec, "_plan_origin",
+                                       new_dec._plan_source),
+                "total_s": time.perf_counter() - t0,
+            })
+            telemetry.event("replan", **decision)
+        self.replan_decisions.append(decision)
+        return decision
 
     # ------------------------------------------------------------ intake
 
@@ -355,6 +454,7 @@ class ServingEngine:
         this iteration."""
         sched = self.scheduler
         done_before = len(sched.completed)
+        self._maybe_autoscale()
         with self._active():
             gate = (self._can_admit
                     if self.block_manager is not None else None)

@@ -1,0 +1,238 @@
+"""ffelastic smoke: drift/capacity-triggered live re-planning on the CPU mesh.
+
+The CI gate for the elastic controller (docs/elastic.md): one training run
+on the virtual 8-device CPU mesh goes through BOTH trigger streams
+in-process and every decision must land in the artifacts run_doctor
+--check re-verifies:
+
+  capacity leg — after one epoch on dp=4, the visible device set shrinks
+  to 2 (injected visible_devices_fn). The controller force-replans onto
+  the (2,1,1,1) mesh at the fit-entry capacity check (the whole next
+  epoch runs on the new plan), the move goes through the verified
+  fftrans/migrate_state path, and the continued trajectory is BIT-EXACT
+  vs a checkpoint-restart control compiled from scratch at the target
+  mesh — params, optimizer slots, step counter.
+
+  drift leg — the monitor's prediction is perturbed to 1/50th of the
+  plan's makespan (the injected-perturbation idiom: measured step times
+  now read as a 50x excursion). The advisory stream must produce a
+  payoff-gated re-plan decision labeled trigger=drift carrying BOTH
+  sides of the inequality (lhs = predicted_migration_s x fidelity_ratio,
+  rhs = benefit_s_per_step x horizon), recalibrate the cost model, and
+  keep training.
+
+Gates asserted here: plan_source "replan" with the origin preserved, the
+elastic section in strategy_report.json reproducing each decision's
+lhs/rhs from its recorded factors, `replan` telemetry events, exactly
+one forced shrink decision, at least one drift decision, and the
+bit-exact control comparison. CI then runs run_doctor --check on the
+telemetry dir, which re-verifies the payoff identity + makespan identity
+from the artifacts alone.
+
+Usage: python scripts/elastic_smoke.py --telemetry-dir OUT [flexflow flags]
+Exits nonzero with a diagnostic on any violated assertion.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# virtual 8-device CPU mesh, exactly like tests/conftest.py
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def fail(msg: str):
+    print(f"elastic_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _flat(tree):
+    import jax.tree_util as jtu
+
+    return {jtu.keystr(p): np.asarray(v)
+            for p, v in jtu.tree_flatten_with_path(tree)[0]}
+
+
+def _build(mesh, extra_argv, base_argv):
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, SGDOptimizer,
+    )
+
+    sys.argv = [sys.argv[0]] + list(base_argv) + list(extra_argv)
+    config = FFConfig()
+    config.mesh_axis_sizes = mesh
+    config.batch_size = 8
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 16), name="x")
+    t = ff.dense(x, 32, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 4, name="fc2")
+    t = ff.softmax(t, name="sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.05, momentum=0.9),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def main():
+    from flexflow_tpu.telemetry import read_jsonl
+
+    argv = sys.argv[1:]
+    tdir = ""
+    if "--telemetry-dir" in argv:
+        tdir = argv[argv.index("--telemetry-dir") + 1]
+    if not tdir:
+        fail("pass --telemetry-dir")
+    base = [a for i, a in enumerate(argv)
+            if a not in ("--telemetry-dir", "--diagnostics")
+            and (i == 0 or argv[i - 1] != "--telemetry-dir")]
+
+    rs = np.random.RandomState(0)
+    n = 48  # 6 steps per epoch at batch 8
+    X = {"x": rs.randn(n, 16).astype(np.float32)}
+    Y = rs.randint(0, 4, (n, 1)).astype(np.int32)
+
+    def fit(ff, seed):
+        sx = {"x": np.roll(X["x"], seed, axis=0)}
+        sy = np.roll(Y, seed, axis=0)
+        ff.fit(sx, sy, epochs=1, batch_size=8, shuffle=False,
+               verbose=False)
+
+    # ---------------------------------------------------- epoch 1 (dp=4)
+    ff = _build((4, 1, 1, 1),
+                ["--telemetry-dir", tdir, "--diagnostics"], base)
+    fit(ff, 0)
+    ckroot = tempfile.mkdtemp(prefix="elastic_smoke_ck_")
+    ff.save_checkpoint(ckroot)
+
+    # ------------------------------------------- capacity leg (4 -> 2)
+    # devices "vanish": the controller must force-replan onto the
+    # 2-device mesh at the fit-entry capacity check so the WHOLE next
+    # epoch runs on the new plan (bit-exact comparable to the control).
+    # The huge cooldown mutes the drift stream for this leg — a shrink
+    # bypasses cooldown by design, nothing else triggers.
+    ctrl = ff.enable_elastic(
+        cooldown_steps=10_000, horizon_steps=1000,
+        visible_devices_fn=lambda: jax.devices()[:2],
+        capacity_check_every=1)
+    fit(ff, 1)
+
+    shrinks = [d for d in ctrl.decisions
+               if d.get("trigger") == "capacity" and d.get("forced")]
+    if len(shrinks) != 1:
+        fail(f"expected exactly one forced shrink decision, got "
+             f"{len(shrinks)}: {ctrl.decisions}")
+    dec = shrinks[0]
+    if dec.get("decision") != "migrated":
+        fail(f"shrink did not migrate: {dec}")
+    if "lhs_s" not in dec or "rhs_s" not in dec:
+        fail(f"forced decision dropped the payoff audit trail: {dec}")
+    if dict(ff.mesh.shape).get("data") != 2:
+        fail(f"post-shrink mesh is not data=2: {dict(ff.mesh.shape)}")
+    if ff._plan_source != "replan":
+        fail(f"plan_source is {ff._plan_source!r}, want 'replan'")
+    if getattr(ff, "_plan_origin", None) is None:
+        fail("replan did not preserve the underlying plan origin")
+
+    # bit-exact vs checkpoint-restart control at the same target mesh
+    control = _build((2, 1, 1, 1), [], base)
+    control.load_checkpoint(ckroot)
+    fit(control, 1)
+    for name, a, b in (("params", control._params, ff._params),
+                       ("opt_slots", control._opt_slots, ff._opt_slots)):
+        fa, fb = _flat(a), _flat(b)
+        if fa.keys() != fb.keys():
+            fail(f"{name} key sets differ after elastic shrink")
+        for k in fa:
+            if not np.array_equal(fa[k], fb[k]):
+                fail(f"elastic {name}{k} != checkpoint-restart control")
+    if int(ff._step) != int(control._step):
+        fail(f"step counter {int(ff._step)} != control "
+             f"{int(control._step)}")
+
+    # --------------------------------------------------- drift leg
+    # inject the perturbation: the monitor now believes the plan should
+    # run 50x faster than it measures — a sustained excursion
+    diag = ff.get_diagnostics()
+    ctrl.cooldown_steps = 6
+    ctrl.watcher._visible_fn = lambda: jax.devices()[:2]  # capacity quiet
+    n_before = len(ctrl.decisions)
+    diag.drift.set_prediction((ff._predicted_step_s or 1e-3) / 50)
+    fit(ff, 2)
+    fit(ff, 3)
+
+    drifts = [d for d in ctrl.decisions[n_before:]
+              if d.get("trigger") == "drift"]
+    if not drifts:
+        fail(f"no drift-triggered decision after the injected "
+             f"perturbation: {ctrl.decisions[n_before:]}")
+    d0 = drifts[0]
+    for k in ("lhs_s", "rhs_s", "predicted_migration_s",
+              "fidelity_ratio", "benefit_s_per_step", "horizon_steps",
+              "research_s", "advisory"):
+        if k not in d0:
+            fail(f"drift decision missing {k}: {d0}")
+    lat = int(d0["step"]) - int(d0["advisory"]["step"])
+    if lat < 0:
+        fail(f"decision step precedes its advisory: {d0}")
+
+    # ----------------------------------------- artifacts + identities
+    report_path = os.path.join(tdir, "strategy_report.json")
+    if not os.path.exists(report_path):
+        fail(f"missing strategy report {report_path}")
+    with open(report_path) as f:
+        report = json.load(f)
+    if report.get("plan_source") != "replan":
+        fail(f"report plan_source {report.get('plan_source')!r}")
+    elastic = report.get("elastic") or {}
+    decs = elastic.get("decisions", [])
+    if len(decs) != len(ctrl.decisions):
+        fail(f"report carries {len(decs)} decisions, controller made "
+             f"{len(ctrl.decisions)}")
+    # every priced decision reproduces from the record alone — the same
+    # identity run_doctor --check re-runs on the uploaded artifact
+    for i, d in enumerate(decs):
+        if d.get("lhs_s") is None:
+            continue
+        lhs = d["predicted_migration_s"] * d["fidelity_ratio"]
+        rhs = d["benefit_s_per_step"] * d["horizon_steps"]
+        for name, got, want in (("lhs_s", d["lhs_s"], lhs),
+                                ("rhs_s", d["rhs_s"], rhs)):
+            if abs(got - want) > 1e-9 + 1e-6 * abs(want):
+                fail(f"decision {i}: {name}={got} does not reproduce "
+                     f"from its factors ({want})")
+
+    ff._telemetry.flush()
+    recs = list(read_jsonl(os.path.join(tdir, "metrics.jsonl")))
+    replans = [r for r in recs if r.get("kind") == "replan"]
+    if len(replans) < len(ctrl.decisions):
+        fail(f"{len(replans)} replan telemetry events for "
+             f"{len(ctrl.decisions)} decisions")
+    migrates = [r for r in recs if r.get("kind") == "migrate"]
+    if not migrates:
+        fail("no migrate event — the elastic moves left no trace")
+
+    mig_pred = dec.get("predicted_migration_s")
+    mig_meas = dec.get("migration_measured_s")
+    print(f"elastic_smoke: OK — {len(ctrl.decisions)} decision(s): "
+          f"1 forced capacity shrink 4->2 (migration predicted "
+          f"{(mig_pred or 0) * 1e3:.3f} ms / measured "
+          f"{(mig_meas or 0) * 1e3:.1f} ms), {len(drifts)} drift "
+          f"re-plan(s) (trigger latency {lat} step(s), re-search "
+          f"{d0['research_s']:.2f} s), bit-exact vs checkpoint-restart "
+          f"control incl. the continued epoch, payoff identity "
+          f"reproduces from the report alone")
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,39 @@ def main():
                         f"transition verification recorded "
                         f"{ta['errors']} error(s) — the migration ran "
                         f"unverified (--no-verify-plan)")
+            # ffelastic gate: every priced re-plan decision must be
+            # reproducible from the record alone — both sides of the
+            # pay-off inequality recompute from their recorded factors,
+            # and the migrate/decline call must match the inequality
+            # (forced = capacity shrink migrates regardless; dry-run
+            # and failed decisions are exempt from the call check)
+            elastic = rep.get("elastic") or {}
+            for i, dec in enumerate(elastic.get("decisions", [])):
+                if dec.get("lhs_s") is None or dec.get("rhs_s") is None:
+                    continue
+                lhs = (dec.get("predicted_migration_s", 0.0)
+                       * dec.get("fidelity_ratio", 1.0))
+                rhs = (dec.get("benefit_s_per_step", 0.0)
+                       * dec.get("horizon_steps", 0))
+                for name, got, want in (("lhs_s", dec["lhs_s"], lhs),
+                                        ("rhs_s", dec["rhs_s"], rhs)):
+                    if abs(got - want) > 1e-9 + 1e-6 * abs(want):
+                        problems.append(
+                            f"elastic decision {i}: recorded {name} "
+                            f"({got}) does not reproduce from its "
+                            f"factors ({want})")
+                forced = bool(dec.get("forced"))
+                call = dec.get("decision")
+                if call == "migrated" and not forced and not lhs < rhs:
+                    problems.append(
+                        f"elastic decision {i}: migrated but the "
+                        f"pay-off inequality does not hold "
+                        f"({lhs} >= {rhs})")
+                if (call == "declined" and not forced
+                        and not dec.get("dry_run") and lhs < rhs):
+                    problems.append(
+                        f"elastic decision {i}: declined but the "
+                        f"pay-off inequality holds ({lhs} < {rhs})")
         if problems:
             print("run_doctor: CHECK FAILED: " + "; ".join(problems),
                   file=sys.stderr)
