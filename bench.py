@@ -915,7 +915,7 @@ def _serving_legs(cfg, on_tpu: bool) -> dict:
                             requests=len(prompts)):
             engine.run_until_drained()
         return ([r.generated for r in engine.scheduler.completed],
-                engine.stats())
+                engine.metrics_summary())
 
     rs = np.random.RandomState(0)
     prompts = [rs.randint(1, cfg.vocab_size, prompt_len).tolist()
@@ -932,7 +932,18 @@ def _serving_legs(cfg, on_tpu: bool) -> dict:
         "max_new_tokens": max_new,
         "kv_layout": stats["kv_layout"],
         "ttft_p50_s": round(stats.get("ttft_p50_s", 0.0), 4),
+        # drain-count accounting: prompts that finished without emitting
+        # a token are excluded from the TTFT denominator by design
+        "no_token_requests": stats.get("no_token_requests", 0),
     }
+    # request-grain tail latency from the engine's mergeable histograms
+    # (engine.metrics_summary) — present whenever the measured window
+    # saw the observation
+    for short in ("queue_wait", "ttft", "tbt", "e2e"):
+        for q in ("p50", "p95", "p99"):
+            key = f"{short}_{q}_s"
+            if key in stats:
+                out[key] = round(stats[key], 6)
 
     # paged shared-prefix leg vs the contiguous ablation on one trace
     system = rs.randint(1, cfg.vocab_size, shared_prefix).tolist()

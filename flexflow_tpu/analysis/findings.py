@@ -59,7 +59,8 @@ _SEVERITIES = (SEV_ERROR, SEV_WARNING, SEV_INFO)
 #                        rules_fingerprint
 #   lint (fflint rules): host_sync_in_loop, unsorted_dict_hash,
 #                        global_rng, time_in_trace,
-#                        unverified_transition, unverified_rule_load
+#                        unverified_transition, unverified_rule_load,
+#                        raw_timer_in_hot_path
 
 
 @dataclass

@@ -52,6 +52,14 @@ Every rule encodes a bug class a past PR fixed by hand:
   becomes a shape crash or silent corruption mid-restore — route
   through `migrate_state` / `verify_restore_transition` (a fresh-init
   placement at compile is not a transition: pragma it).
+- `raw_timer_in_hot_path` — two or more bare `time.perf_counter()` /
+  `time.time()` reads (a start/stop pair) inside a step/decode/prefill
+  hot-path function outside `telemetry/`. A hand-rolled timer pair is a
+  measurement the ffpulse metrics plane never sees — route it through
+  `telemetry.span(...)` or `telemetry.observe(...)` so it lands in the
+  mergeable histograms, or gate it behind a telemetry check. Sites
+  where the raw read IS the product (the device-sync timing the span
+  wraps, wall-clock pacing) carry the pragma.
 - `unverified_rule_load` — a call that constructs or loads
   `GraphXfer`s (`load_rule_collection` without the verifying `config=`
   argument, `compile_pattern_rule`, `generate_all_pcg_xfers`) in a
@@ -83,7 +91,8 @@ PASS_NAME = "fflint"
 ALL_RULES = ("host_sync_in_loop", "unsorted_dict_hash", "global_rng",
              "time_in_trace", "coordinator_collective", "donated_reuse",
              "low_precision_accum", "host_divergent_branch",
-             "unverified_transition", "unverified_rule_load")
+             "unverified_transition", "unverified_rule_load",
+             "raw_timer_in_hot_path")
 
 # identifiers whose presence in an `if` test marks the branch as a
 # telemetry/diagnostics gate (a gated fetch is the sanctioned pattern)
@@ -147,6 +156,14 @@ _RULE_CHECKERS = {"verify_rule", "verify_rules", "verify_registry",
 # summing reductions the low-precision-accumulation rule watches
 # (order statistics — max/min/argmax — carry no accumulation error)
 _SUM_FUNCS = {"sum", "mean", "prod", "cumsum", "logsumexp", "einsum"}
+
+# hot-path function name hints for the raw-timer rule — the per-step /
+# per-token functions whose measurements belong in the metrics plane
+_HOT_PATH_HINTS = ("step", "decode", "prefill")
+# bare-name timer calls (`from time import perf_counter` idiom); the
+# dotted `time.X` forms reuse _TIME_FUNCS
+_BARE_TIMER_NAMES = {"perf_counter", "monotonic", "perf_counter_ns",
+                     "monotonic_ns"}
 
 
 def _dotted(node) -> str:
@@ -764,6 +781,61 @@ class _FileLint:
                 f"wrong plan; pass config= to load_rule_collection or "
                 f"route through analysis.rules.verify_rules (the "
                 f"CI-swept built-in registry is exempt: pragma it)")
+
+    # ---------------------------------- rule: raw timer in hot path
+
+    def _timer_call(self, call) -> str:
+        d = _dotted(call.func)
+        parts = d.split(".")
+        if len(parts) == 2 and parts[0] == "time" \
+                and parts[1] in _TIME_FUNCS:
+            return d
+        if len(parts) == 1 and parts[0] in _BARE_TIMER_NAMES:
+            return d
+        return ""
+
+    def rule_raw_timer_in_hot_path(self):
+        # telemetry/ is the one place raw clock reads are the point:
+        # the span/observe implementations themselves
+        if "telemetry" in os.path.normpath(self.path).split(os.sep):
+            return
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(h in fn.name.lower() for h in _HOT_PATH_HINTS):
+                continue
+            gates = self._gate_names(fn)
+            timers = []
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call) or \
+                        not self._timer_call(call):
+                    continue
+                if self._enclosing_def(call) is not fn:
+                    continue  # nested defs get their own pass
+                # a read inside an `if tel is not None:` branch is the
+                # sanctioned gated-measurement idiom
+                gated = False
+                cur = self._parents.get(id(call))
+                while cur is not None and cur is not fn:
+                    if isinstance(cur, ast.If) and \
+                            self._mentions_gate(cur.test, gates):
+                        gated = True
+                        break
+                    cur = self._parents.get(id(cur))
+                if not gated:
+                    timers.append(call)
+            if len(timers) < 2:
+                continue  # a lone read is not a measurement pair
+            second = sorted(timers, key=lambda c: (c.lineno,
+                                                   c.col_offset))[1]
+            self._emit(
+                second, SEV_WARNING, "raw_timer_in_hot_path",
+                f"{len(timers)} bare timer reads in hot-path function "
+                f"{fn.name}() — a hand-rolled start/stop pair the "
+                f"metrics plane never sees; wrap the region in "
+                f"telemetry.span(...) or feed the delta to "
+                f"telemetry.observe(...) so it lands in the mergeable "
+                f"histograms", timer_reads=len(timers))
 
     # ---------------------------------------------------------------- run
 

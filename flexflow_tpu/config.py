@@ -147,6 +147,13 @@ class FFConfig:
     # device-level XProf timelines (docs/observability.md)
     telemetry_dir: str = ""
     xprof_dir: str = ""
+    # ffpulse continuous export (telemetry/export.py, needs telemetry):
+    # metrics_interval > 0 writes a rolling metrics_snapshot record +
+    # metrics.prom every N seconds; metrics_port serves the latest
+    # snapshot at /metrics and liveness at /healthz on 127.0.0.1
+    # (coordinator-only; port 0 = off)
+    metrics_interval: float = 0.0
+    metrics_port: int = 0
     # diagnostics (diagnostics/): strategy explain report at compile,
     # online cost-model drift monitoring and run-health anomaly rules
     # during fit. Requires telemetry (the artifacts live in its dir).
@@ -451,6 +458,10 @@ class FFConfig:
                 self.telemetry_dir = val()
             elif a == "--xprof-dir":
                 self.xprof_dir = val()
+            elif a == "--metrics-interval":
+                self.metrics_interval = float(val())
+            elif a == "--metrics-port":
+                self.metrics_port = int(val())
             elif a == "--diagnostics":
                 self.diagnostics = True
             elif a == "--drift-threshold":

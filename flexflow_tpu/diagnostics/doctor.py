@@ -105,6 +105,35 @@ def diagnose(directory: str) -> dict:
             elif e.get("name") == "tracer.dropped_events":
                 dropped_events = int(e.get("args", {}).get("dropped", 0))
 
+    # ffpulse: the LAST metrics_snapshot is the run's final merged
+    # registry state; derive the latency/goodput/pool tables from it
+    snapshots = by_kind.get("metrics_snapshot", [])
+    metrics_plane = None
+    if snapshots:
+        from ..telemetry.metrics import percentile_from_hist
+
+        snap = snapshots[-1].get("metrics", {}) or {}
+        bounds_map = snap.get("bucket_bounds", {})
+        latency = {}
+        for key, h in (snap.get("histograms") or {}).items():
+            if not h.get("count"):
+                continue
+            bounds = tuple(bounds_map.get(h.get("bounds_id"), ()))
+            row = {"count": h["count"],
+                   "mean_s": h["sum"] / h["count"]}
+            for q in (50, 95, 99):
+                row[f"p{q}_s"] = percentile_from_hist(
+                    h, q, bounds=bounds or None)
+            row["max_s"] = h.get("max")
+            latency[key] = row
+        metrics_plane = {
+            "snapshots": len(snapshots),
+            "reason": snapshots[-1].get("reason"),
+            "latency": latency,
+            "gauges": snap.get("gauges", {}),
+            "counters": snap.get("counters", {}),
+        }
+
     preempted = bool(by_kind.get("preempted"))
     resumed = bool(by_kind.get("resume"))
     errors = [a for a in alerts if a.get("level") == "error"]
@@ -139,6 +168,7 @@ def diagnose(directory: str) -> dict:
         },
         "preempted": preempted,
         "resumed": resumed,
+        "metrics_plane": metrics_plane,
         "replans": replans,
         "trace_spans": spans,
         "trace_dropped_events": dropped_events,
@@ -213,6 +243,45 @@ def render(d: dict) -> str:
                 f"| {r.get('decision', '?')} | {_ms(r.get('lhs_s'))} "
                 f"| {_ms(r.get('rhs_s'))} "
                 f"| {_ms(r.get('migration_measured_s'))} |")
+
+    mp = d.get("metrics_plane")
+    if mp:
+        lines += ["", "## Metrics plane (ffpulse)", "",
+                  f"{mp['snapshots']} snapshot(s); last reason: "
+                  f"`{mp['reason']}`"]
+        if mp["latency"]:
+            lines += ["", "### Latency (bucket-estimated percentiles)",
+                      "",
+                      "| series | count | p50 (ms) | p95 (ms) | p99 (ms) "
+                      "| mean (ms) | max (ms) |",
+                      "|---|---|---|---|---|---|---|"]
+            for key, row in sorted(mp["latency"].items()):
+                def _ms(v):
+                    return f"{v * 1e3:.3f}" if v is not None else "—"
+
+                lines.append(
+                    f"| {key} | {row['count']} | {_ms(row['p50_s'])} "
+                    f"| {_ms(row['p95_s'])} | {_ms(row['p99_s'])} "
+                    f"| {_ms(row['mean_s'])} | {_ms(row['max_s'])} |")
+        goodput = {k: v for k, v in mp["gauges"].items()
+                   if k.startswith("train_") or k.endswith("_per_sec")}
+        pool = {k: v for k, v in mp["gauges"].items()
+                if k.startswith("serve_")}
+        if goodput:
+            lines += ["", "### Goodput", "", "| gauge | value |",
+                      "|---|---|"]
+            for k, v in sorted(goodput.items()):
+                lines.append(f"| {k} | {v:.4g} |")
+        if pool:
+            lines += ["", "### Serving slots / block pool", "",
+                      "| gauge | value |", "|---|---|"]
+            for k, v in sorted(pool.items()):
+                lines.append(f"| {k} | {v:.4g} |")
+        if mp["counters"]:
+            lines += ["", "### Counters", "", "| counter | value |",
+                      "|---|---|"]
+            for k, v in sorted(mp["counters"].items()):
+                lines.append(f"| {k} | {v:.0f} |")
 
     if d["drift"]:
         dr = d["drift"]

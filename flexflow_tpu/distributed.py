@@ -112,6 +112,46 @@ def broadcast_json(payload: Optional[dict], max_bytes: int = 1 << 20) -> dict:
     return data
 
 
+def gather_json(payload: dict, max_bytes: int = 1 << 20) -> list:
+    """All-gather one JSON-serializable dict per process; every process
+    returns the list ordered by process index (single-process: [payload]).
+    Same fixed-buffer framing as `broadcast_json` so every process
+    contributes an identically-shaped array. This is the collective under
+    ffpulse's coordinator-side metrics merge: each process gathers local
+    registry snapshots, then `telemetry.metrics.merge_snapshots` folds
+    them bucket-wise on the coordinator. A per-process serialization
+    failure becomes an empty frame ({}), never a hang."""
+    if jax.process_count() <= 1:
+        return [payload]
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(max_bytes, dtype=np.uint8)
+    try:
+        raw = json.dumps(payload).encode()
+        if len(raw) + 4 > max_bytes:
+            raise ValueError("payload too large")
+    except Exception:
+        raw = b"{}"
+    buf[:4] = np.frombuffer(np.uint32(len(raw)).tobytes(), dtype=np.uint8)
+    buf[4:4 + len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    gathered = multihost_utils.process_allgather(buf)
+    out = []
+    for row in np.asarray(gathered).reshape(jax.process_count(), -1):
+        n = int(np.frombuffer(bytes(row[:4]), dtype=np.uint32)[0])
+        out.append(json.loads(bytes(row[4:4 + n]).decode()))
+    return out
+
+
+def gather_merged_snapshot(session) -> dict:
+    """Fleet-merged metrics snapshot: every process contributes its
+    session's local snapshot (collective — all processes must call);
+    the merged result is identical everywhere, coordinator typically
+    writes it. Single-process = the local merge."""
+    from .telemetry.metrics import merge_snapshots
+
+    return merge_snapshots(gather_json(session.collect_snapshot()))
+
+
 def run_search_on_host0(search_fn: Callable[[], "object"]) -> dict:
     """Run `search_fn` (returning a Strategy) on process 0 only; everyone
     receives the serialized plan. Avoids divergent plans when on-device

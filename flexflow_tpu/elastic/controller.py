@@ -147,6 +147,8 @@ class ElasticController:
                 "reason": "no mesh factorization for visible device set",
             }
             self.decisions.append(decision)
+            telemetry.inc("elastic_replan_decisions_total",
+                          decision="declined", trigger="capacity")
             telemetry.event("replan", **decision)
             if self.diag is not None:
                 self.diag._alerts.record(

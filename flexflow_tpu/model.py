@@ -1280,6 +1280,40 @@ class FFModel:
         self._state = self.executor.replicate(self._state) if self._state else self._state
         self._step = self.executor.replicate(jnp.zeros((), jnp.int32))
         self._counters = self.executor.replicate(self.metrics.zero_counters())
+        # --- ffpulse goodput anchor: cost-model forward FLOPs summed over
+        # the compiled graph (x3 for fwd+bwd, the standard training
+        # estimate) against the machine model's aggregate chip peak — the
+        # two MFU factors record_step divides by measured step time. Best
+        # effort: an op without a flops estimate just undercounts.
+        self._goodput_anchor = None
+        try:
+            from .search.cost_model import _NON_COMPUTE
+            from .search.machine_model import detect_chip
+
+            fwd = 0.0
+            for node in self.graph.topo_order():
+                if (node.op_type in _NON_COMPUTE or not node.outputs
+                        or not node.inputs):
+                    continue
+                try:
+                    shapes_in = [pt.shape.logical_shape
+                                 for pt in node.inputs]
+                    shapes_out = [pt.shape.logical_shape
+                                  for pt in node.outputs]
+                    fwd += node.op_def.flops(node.params, shapes_in,
+                                             shapes_out)
+                except Exception:
+                    continue
+            if fwd > 0:
+                num_chips = int(self.mesh.devices.size)
+                self._goodput_anchor = {
+                    "flops_per_step": 3.0 * fwd,
+                    "peak_flops": detect_chip().peak_flops * num_chips,
+                    "num_chips": num_chips,
+                }
+                telemetry.event("goodput_anchor", **self._goodput_anchor)
+        except Exception:
+            pass
         self._compiled = True
 
     def _assign_strategy(self):
@@ -1637,6 +1671,16 @@ class FFModel:
             # idempotent: covers sessions attached after compile (keras
             # Telemetry callback, manual enable_telemetry)
             tel.write_manifest(self)
+            # ffpulse: MFU/tokens-per-sec anchors from the compile-time
+            # cost model, and continuous export when configured
+            anchor = getattr(self, "_goodput_anchor", None)
+            if anchor is not None:
+                tel.set_goodput(anchor["flops_per_step"],
+                                anchor["peak_flops"])
+            if self.config.metrics_interval or self.config.metrics_port:
+                tel.start_exporter(
+                    interval_s=self.config.metrics_interval,
+                    port=self.config.metrics_port)
         if self.config.sanitize_numerics:
             # a fresh fit gets a fresh provenance window: stale
             # non-finite reports from an earlier (diverged) fit in the
@@ -1967,6 +2011,7 @@ class FFModel:
                     if diag is not None:
                         diag.on_fit_end()
                     tel.write_summary()
+                    tel.write_metrics_snapshot(reason="fit_end")
                     tel.flush()
                     telemetry.deactivate(tel)
 

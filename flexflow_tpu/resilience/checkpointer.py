@@ -333,10 +333,13 @@ class AsyncCheckpointer:
             if telemetry.active_session() is not None:
                 # guarded: the bytes sum walks every state leaf — wasted
                 # work on the (default) telemetry-off path
+                commit_s = time.perf_counter() - t_commit0
+                telemetry.inc("checkpoints_total")
+                telemetry.observe("checkpoint_commit_s", commit_s)
                 telemetry.event(
                     "checkpoint", step=int(step),
                     snapshot_s=self._snapshot_s, serialize_s=serialize_s,
-                    commit_s=time.perf_counter() - t_commit0,
+                    commit_s=commit_s,
                     bytes=int(sum(np.asarray(v).nbytes
                                   for v in flat.values())),
                     staleness_s=staleness)

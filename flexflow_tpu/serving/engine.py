@@ -160,6 +160,39 @@ class ServingEngine:
         self._prefill_tokens = 0
         self._prefill_calls = 0
         self._device_s = 0.0
+        # ffpulse metrics plane: engine-owned registry so serving metrics
+        # exist (and metrics_summary works) without a telemetry dir, and
+        # reset_stats can zero the serving series alone. Every series the
+        # step loop touches is created HERE — a serving step allocates no
+        # metric objects (the overhead-guard invariant).
+        from ..telemetry.metrics import MetricsRegistry
+
+        reg = self.metrics = MetricsRegistry()
+        self._h_queue_wait = reg.histogram("serve_queue_wait_s")
+        self._h_ttft = reg.histogram("serve_ttft_s")
+        self._h_tbt = reg.histogram("serve_tbt_s")
+        self._h_e2e = reg.histogram("serve_e2e_s")
+        self._h_step_device = reg.histogram("serve_step_device_s")
+        self._g_slots_active = reg.gauge("serve_slots_active")
+        self._g_slots_total = reg.gauge("serve_slots_total")
+        self._g_slots_total.set(spec.slots)
+        self._g_queue_depth = reg.gauge("serve_queue_depth")
+        self._g_blocks_free = reg.gauge("serve_kv_blocks_free")
+        self._g_blocks_used = reg.gauge("serve_kv_blocks_used")
+        self._g_blocks_reserved = reg.gauge("serve_kv_blocks_reserved")
+        self._c_cow_copies = reg.counter("serve_cow_copies_total")
+        self._c_tokens_out = reg.counter("serve_tokens_generated_total")
+        self._c_prefill_tok = reg.counter("serve_prefill_tokens_total")
+        self._c_completed = {
+            r: reg.counter("serve_requests_completed_total", reason=r)
+            for r in ("eos", "max_tokens", "length")}
+        if self.telemetry is not None:
+            self.telemetry.attach_registry(reg)
+            if getattr(cfg, "metrics_interval", 0) or getattr(
+                    cfg, "metrics_port", 0):
+                self.telemetry.start_exporter(
+                    interval_s=getattr(cfg, "metrics_interval", 0.0),
+                    port=getattr(cfg, "metrics_port", 0))
         # elastic decode-mesh scaling (--elastic): poll the visible
         # device set between steps and grow/shrink the decode mesh via
         # replan_mesh; in-flight requests ride through untouched
@@ -305,6 +338,9 @@ class ServingEngine:
                     f"pool only has {mgr.num_blocks - 1} allocatable "
                     f"blocks; raise kv_num_blocks (or lower "
                     f"max_new_tokens / kv_block_size)")
+        with self._active():
+            telemetry.instant("serve.queued", trace=req.trace_id,
+                              prompt_tokens=len(req.prompt))
         return self.scheduler.submit(req)
 
     # ------------------------------------------------------------ device step
@@ -356,7 +392,11 @@ class ServingEngine:
             jnp.asarray(read_idx, jnp.int32), sub,
             jnp.asarray(temp))
         out = np.asarray(jax.device_get(next_tok))
-        self._device_s += time.perf_counter() - t0
+        # this pair IS the serve_step_device_s measurement (observed
+        # below) — a span here would double-record every decode step
+        dt = time.perf_counter() - t0  # fflint: ok raw_timer_in_hot_path
+        self._device_s += dt
+        self._h_step_device.observe(dt)
         if dec.config.sanitize_numerics:
             self._check_numerics()
         return out
@@ -414,6 +454,7 @@ class ServingEngine:
         for i, c in enumerate(copies):
             src[i], dst[i] = c.src, c.dst
         dec = self.decode_model
+        self._c_cow_copies.inc(len(copies))
         with telemetry.span("serve.cow_copy", blocks=len(copies)):
             dec._state = self._copy_fn(
                 dec._state, jnp.asarray(src), jnp.asarray(dst))
@@ -432,13 +473,22 @@ class ServingEngine:
     def _note_completion(self, slot, req: Request):
         if self.block_manager is not None:
             self.block_manager.release(slot.index)
+        if req.e2e_s is not None:
+            self._h_e2e.observe(req.e2e_s)
+        c = self._c_completed.get(req.finish_reason)
+        if c is None:  # unknown reason: labeled child created off-path
+            c = self.metrics.counter("serve_requests_completed_total",
+                                     reason=req.finish_reason or "unknown")
+        c.inc()
         telemetry.instant("serve.done", request=req.request_id,
-                          reason=req.finish_reason)
+                          trace=req.trace_id, reason=req.finish_reason)
         telemetry.event(
             "serve.request", request_id=req.request_id,
+            trace=req.trace_id,
             prompt_tokens=len(req.prompt), new_tokens=len(req.generated),
             finish_reason=req.finish_reason,
             ttft_s=req.ttft_s,
+            queue_wait_s=req.queue_wait_s,
             total_s=(req.finish_t - req.submit_t
                      if req.finish_t is not None else None))
 
@@ -462,8 +512,19 @@ class ServingEngine:
                 if self.block_manager is not None:
                     self.block_manager.bind_reservation(
                         req.request_id, slot.index)
+                self._h_queue_wait.observe(req.queue_wait_s)
+                telemetry.instant("serve.admitted", trace=req.trace_id,
+                                  slot=slot.index,
+                                  queue_wait_s=req.queue_wait_s)
             prefilling = [s for s in sched.slots if s.prefilling]
             decoding = [s for s in sched.slots if s.decoding]
+            self._g_slots_active.set(len(prefilling) + len(decoding))
+            self._g_queue_depth.set(sched.queue_depth)
+            if self.block_manager is not None:
+                mgr = self.block_manager
+                self._g_blocks_free.set(mgr.free_blocks)
+                self._g_blocks_used.set(mgr.blocks_in_use)
+                self._g_blocks_reserved.set(mgr.reserved_total)
             telemetry.counter("serve.slots", {
                 "active": len(prefilling) + len(decoding),
                 "queue": sched.queue_depth,
@@ -521,6 +582,7 @@ class ServingEngine:
 
             span = telemetry.span(
                 "serve.prefill", slot=pre.index,
+                trace=pre.request.trace_id,
                 start=start, tokens=n,
                 prompt_tokens=len(pre.request.prompt),
                 decoding=len(decoding)) if pre is not None else \
@@ -531,6 +593,7 @@ class ServingEngine:
             # ---- prefill bookkeeping (the chunk's writes landed)
             if pre is not None:
                 self._prefill_tokens += n
+                self._c_prefill_tok.inc(n)
                 self._prefill_calls += 1
                 pre.prefill_pos += n
                 req = pre.request
@@ -543,8 +606,10 @@ class ServingEngine:
                     # the final chunk's last live logits row samples the
                     # request's first token (TTFT lands here)
                     self._decode_tokens += 1
+                    prev_t = req.last_token_t
                     if sched.note_token(pre, int(next_tok[pre.index])):
                         self._note_completion(pre, req)
+                    self._observe_token(req, prev_t)
             # ---- decode bookkeeping
             if decoding:
                 self._decode_iterations += 1
@@ -552,9 +617,22 @@ class ServingEngine:
                 s.length += 1
                 req = s.request
                 self._decode_tokens += 1
+                prev_t = req.last_token_t
                 if sched.note_token(s, int(next_tok[s.index])):
                     self._note_completion(s, req)
+                self._observe_token(req, prev_t)
         return sched.completed[done_before:]
+
+    def _observe_token(self, req: Request, prev_t):
+        """Latency bookkeeping for one sampled token: the request's first
+        token lands TTFT, every later one lands a TBT observation."""
+        self._c_tokens_out.inc()
+        if prev_t is None:
+            self._h_ttft.observe(req.ttft_s)
+            telemetry.instant("serve.first_token", trace=req.trace_id,
+                              ttft_s=req.ttft_s)
+        else:
+            self._h_tbt.observe(req.last_token_t - prev_t)
 
     def run_until_drained(self, max_iterations: int = 0) -> list[Request]:
         """Iterate until queue and slots are empty; returns every request
@@ -568,12 +646,22 @@ class ServingEngine:
             it += 1
             if max_iterations and it >= max_iterations:
                 break
-        self._last_wall_s = time.perf_counter() - t0
-        with self._active():
-            telemetry.event("serve.summary", **self.stats())
-        if self.telemetry is not None:
-            self.telemetry.flush()
+        self.note_drain(time.perf_counter() - t0)
         return done
+
+    def note_drain(self, wall_s: float):
+        """Close one measured window: record its wall-clock, emit the
+        summary event, and export a drained metrics snapshot. The
+        drain loop above calls this; open-loop drivers (serve_bench's
+        --arrival-rate mode) step the engine themselves and call it
+        directly when their trace completes."""
+        self._last_wall_s = wall_s
+        with self._active():
+            telemetry.event("serve.summary", **self.metrics_summary())
+        if self.telemetry is not None:
+            self.telemetry.write_metrics_snapshot(
+                reason="serve_drain", drained=bool(self.scheduler.drained))
+            self.telemetry.flush()
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  **request_kw) -> list[list[int]]:
@@ -596,6 +684,13 @@ class ServingEngine:
         self._prefill_calls = 0
         self._device_s = 0.0
         self._last_wall_s = 0.0
+        # zero the serving series (objects survive — the step loop holds
+        # references); the stats_reset event marks the window boundary so
+        # doctor's TTFT identity counts serve.request events after it
+        self.metrics.reset(prefix="serve_")
+        self._g_slots_total.set(self.spec.slots)
+        with self._active():
+            telemetry.event("serve.stats_reset")
         if self.block_manager is not None:
             from .paged import PagedStats
 
@@ -612,9 +707,21 @@ class ServingEngine:
         (`device_s` reports the device-busy slice separately;
         requests/s/chip is the ROADMAP's serving bench target)."""
         completed = self.scheduler.completed
+        sched = self.scheduler
         wall = getattr(self, "_last_wall_s", 0.0) or 0.0
         ttfts = [r.ttft_s for r in completed if r.ttft_s is not None]
+        # drain-time accounting gap: requests that never emitted a token
+        # (still queued, mid-prefill at shutdown, or defensively a
+        # completed request with no first_token_t) are EXCLUDED from the
+        # TTFT population above by design — a queue-depth artifact is not
+        # a latency sample — but must not vanish: they count here.
+        no_token = (len(sched.pending)
+                    + sum(1 for s in sched.active_slots
+                          if s.request.first_token_t is None)
+                    + sum(1 for r in completed
+                          if r.first_token_t is None))
         out = {
+            "no_token_requests": no_token,
             "slots": self.spec.slots,
             "max_seq_len": self.max_seq_len,
             "num_chips": self.num_chips,
@@ -654,6 +761,29 @@ class ServingEngine:
                 len(completed) / wall / self.num_chips)
             out["decode_tokens_per_sec_per_chip"] = (
                 self._decode_tokens / wall / self.num_chips)
+        return out
+
+    def metrics_summary(self) -> dict:
+        """stats() plus request-grain latency percentiles rebuilt from
+        the engine's mergeable histograms — callable MID-RUN (histograms
+        are cumulative; no drained completed-list needed), and the
+        drain-time serve.summary event is exactly this dict. Old stats()
+        keys are preserved; `ttft_p50_s`/`ttft_max_s` are re-derived from
+        the histogram (estimate within one bucket width, max exact)."""
+        from ..telemetry.metrics import percentile_from_hist
+
+        out = self.stats()
+        for short, h in (("queue_wait", self._h_queue_wait),
+                         ("ttft", self._h_ttft),
+                         ("tbt", self._h_tbt),
+                         ("e2e", self._h_e2e)):
+            if h.count == 0:
+                continue
+            hd = h.to_dict()
+            for q in (50, 95, 99):
+                out[f"{short}_p{q}_s"] = percentile_from_hist(hd, q)
+            out[f"{short}_max_s"] = h.max
+            out[f"{short}_mean_s"] = h.sum / h.count
         return out
 
     def kv_bytes_per_layer(self) -> int:

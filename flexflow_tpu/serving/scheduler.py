@@ -36,14 +36,34 @@ class Request:
     finished: bool = False
     finish_reason: str = ""  # eos | max_tokens | length
     submit_t: float = field(default_factory=time.perf_counter)
+    admit_t: Optional[float] = None  # slot assignment (queue wait ends)
     first_token_t: Optional[float] = None  # TTFT anchor
+    last_token_t: Optional[float] = None  # previous token (TBT anchor)
     finish_t: Optional[float] = None
+
+    @property
+    def trace_id(self) -> str:
+        """Request-grain trace id threaded through every span/event of
+        this request's lifecycle (queued→admitted→prefill→tokens→done)."""
+        return f"req-{self.request_id}"
 
     @property
     def ttft_s(self) -> Optional[float]:
         if self.first_token_t is None:
             return None
         return self.first_token_t - self.submit_t
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.admit_t is None:
+            return None
+        return self.admit_t - self.submit_t
+
+    @property
+    def e2e_s(self) -> Optional[float]:
+        if self.finish_t is None:
+            return None
+        return self.finish_t - self.submit_t
 
     @property
     def tokens(self) -> list[int]:
@@ -149,6 +169,7 @@ class ContinuousBatchingScheduler:
                 break
             req = self.pending.pop(0)
             slot.assign(req)
+            req.admit_t = time.perf_counter()
             self._admit_counter += 1
             slot.admit_seq = self._admit_counter
             out.append((slot, req))
@@ -170,8 +191,10 @@ class ContinuousBatchingScheduler:
         """
         req = slot.request
         req.generated.append(int(token))
+        now = time.perf_counter()
         if req.first_token_t is None:
-            req.first_token_t = time.perf_counter()
+            req.first_token_t = now
+        req.last_token_t = now
         reason = ""
         if req.eos_id is not None and int(token) == int(req.eos_id):
             reason = "eos"
@@ -182,7 +205,7 @@ class ContinuousBatchingScheduler:
         if reason:
             req.finished = True
             req.finish_reason = reason
-            req.finish_t = time.perf_counter()
+            req.finish_t = now
             self.completed.append(slot.release())
             return True
         slot.last_token = int(token)

@@ -22,14 +22,17 @@ from __future__ import annotations
 from typing import Optional
 
 from . import log  # noqa: F401  (flexflow_tpu.telemetry.log)
+from .metrics import MetricsRegistry  # noqa: F401  (re-export)
 from .recorder import MetricsRecorder, read_jsonl
 from .session import TelemetrySession
 from .tracer import Tracer
 
 __all__ = [
-    "Tracer", "MetricsRecorder", "TelemetrySession", "read_jsonl", "log",
+    "Tracer", "MetricsRecorder", "MetricsRegistry", "TelemetrySession",
+    "read_jsonl", "log",
     "activate", "deactivate", "active_session",
     "span", "instant", "counter", "event",
+    "inc", "observe", "set_gauge",
 ]
 
 _active: Optional[TelemetrySession] = None
@@ -96,3 +99,28 @@ def event(kind: str, **fields):
     s = _active
     if s is not None:
         s.recorder.record(kind, **fields)
+
+
+# ffpulse registry dispatch (metrics.py): same one-global-read no-op
+# contract as span/instant — with telemetry off, no registry (and no
+# metric object) is ever touched or created.
+
+def inc(name: str, value: float = 1.0, **labels):
+    """Counter increment on the active session's registry."""
+    s = _active
+    if s is not None:
+        s.metrics.counter(name, **labels).inc(value)
+
+
+def observe(name: str, value: float, **labels):
+    """Histogram observation on the active session's registry."""
+    s = _active
+    if s is not None:
+        s.metrics.histogram(name, **labels).observe(value)
+
+
+def set_gauge(name: str, value: float, **labels):
+    """Gauge set on the active session's registry."""
+    s = _active
+    if s is not None:
+        s.metrics.gauge(name, **labels).set(value)

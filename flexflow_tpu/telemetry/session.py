@@ -17,6 +17,7 @@ import os
 import time
 from typing import Optional
 
+from .metrics import MetricsRegistry, merge_snapshots, percentile_from_hist
 from .recorder import MetricsRecorder, git_sha
 from .tracer import Tracer
 
@@ -31,7 +32,17 @@ _MANIFEST_CONFIG_FIELDS = (
     "checkpoint_every_seconds", "auto_resume", "seed",
     "diagnostics", "drift_threshold", "pipeline_steps",
     "health_sample_every", "warmstart_dir",
+    "metrics_interval", "metrics_port",
 )
+
+
+def _is_coordinator() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
 
 
 class TelemetrySession:
@@ -43,8 +54,23 @@ class TelemetrySession:
             os.path.join(self.directory, "metrics.jsonl"))
         self.trace_path = os.path.join(self.directory, "trace.json")
         self._manifest_written = False
-        # step accounting
-        self._step_times: list[float] = []
+        # ffpulse registry: session-owned metrics plus any attached
+        # registries (e.g. a serving engine's); snapshots merge them all
+        self.metrics = MetricsRegistry()
+        self._registries: list = [self.metrics]
+        self.exporter = None
+        # step accounting — histogram-backed (bounded, mergeable); the
+        # histogram is pre-created so record_step never allocates series
+        self._h_step = self.metrics.histogram("train_step_time_s")
+        self._g_tokens_per_sec = self.metrics.gauge("train_tokens_per_sec")
+        self._g_examples_per_sec = self.metrics.gauge(
+            "train_examples_per_sec")
+        self._g_mfu = self.metrics.gauge("train_mfu")
+        self._c_tokens = self.metrics.counter("train_tokens_total")
+        # goodput anchors (set_goodput): cost-model FLOPs per optimizer
+        # step and the machine-model aggregate chip peak, for MFU
+        self._flops_per_step: Optional[float] = None
+        self._peak_flops: Optional[float] = None
         self._ema: Optional[float] = None
         self._examples = 0
         self._tokens = 0
@@ -96,6 +122,59 @@ class TelemetrySession:
                 }
         self.recorder.record("manifest", **fields)
 
+    # ------------------------------------------------------------ metrics
+
+    def attach_registry(self, registry: MetricsRegistry):
+        """Fold another registry (e.g. a serving engine's) into every
+        snapshot this session exports."""
+        if registry not in self._registries:
+            self._registries.append(registry)
+
+    def collect_snapshot(self) -> dict:
+        """Merged point-in-time snapshot of every attached registry —
+        the same merge a cross-host gather would apply."""
+        return merge_snapshots([r.snapshot() for r in self._registries])
+
+    def _get_exporter(self):
+        if self.exporter is None:
+            from .export import MetricsExporter
+
+            self.exporter = MetricsExporter(
+                self.directory, collect=self.collect_snapshot,
+                record=self.recorder.record)
+        return self.exporter
+
+    def start_exporter(self, interval_s: float = 0.0, port: int = 0):
+        """Begin continuous export (interval snapshot writer and/or the
+        /metrics endpoint). Coordinator-only: non-coordinator processes
+        get a no-op so one file/port exists per fleet."""
+        if not _is_coordinator():
+            return None
+        exp = self._get_exporter()
+        if interval_s > 0:
+            exp.interval_s = float(interval_s)
+        if port:
+            exp.port = int(port)
+        exp.start()
+        return exp
+
+    def write_metrics_snapshot(self, reason: str = "manual",
+                               **flags) -> Optional[dict]:
+        """Export one snapshot now (JSONL record + metrics.prom)."""
+        if self._closed or not _is_coordinator():
+            return None
+        return self._get_exporter().snapshot_now(reason, **flags)
+
+    def set_goodput(self, flops_per_step: Optional[float],
+                    peak_flops: Optional[float]):
+        """Anchor MFU: `flops_per_step` from the search cost model over
+        the compiled graph, `peak_flops` = chip peak × chips from the
+        machine model. Either None disables the MFU gauge."""
+        if flops_per_step and flops_per_step > 0:
+            self._flops_per_step = float(flops_per_step)
+        if peak_flops and peak_flops > 0:
+            self._peak_flops = float(peak_flops)
+
     # ------------------------------------------------------------ steps
 
     def note_compile_start(self, t: Optional[float] = None):
@@ -116,39 +195,58 @@ class TelemetrySession:
             # staging + the step itself — the restart latency warm start
             # exists to collapse
             self._time_to_first_step = time.perf_counter() - self._compile_t0
-        self._step_times.append(step_time)
+        self._h_step.observe(step_time)
         self._ema = (step_time if self._ema is None
                      else 0.9 * self._ema + 0.1 * step_time)
+        step_tokens = batch_size * tokens_per_example
         self._examples += batch_size
-        self._tokens += batch_size * tokens_per_example
+        self._tokens += step_tokens
         self._train_seconds += step_time
+        # goodput gauges: instantaneous per-step rates + MFU against the
+        # cost-model/machine-model anchor (set_goodput)
+        self._c_tokens.inc(step_tokens)
+        mfu = None
+        if step_time > 0:
+            self._g_tokens_per_sec.set(step_tokens / step_time)
+            self._g_examples_per_sec.set(batch_size / step_time)
+            if self._flops_per_step and self._peak_flops:
+                mfu = self._flops_per_step / (step_time * self._peak_flops)
+                self._g_mfu.set(mfu)
+        extra = {} if mfu is None else {"mfu": mfu}
         self.recorder.record(
             "step", step=int(step), epoch=int(epoch),
             step_time_s=step_time, data_wait_s=data_wait,
             save_latency_s=save_latency,
             device_time_s=max(0.0, step_time - data_wait - save_latency),
-            ema_step_time_s=self._ema)
+            ema_step_time_s=self._ema, **extra)
 
     def write_summary(self):
         """Cumulative percentile summary over every step recorded so far.
         Each fit() call writes one on exit, so consumers take the LAST
         summary record as the run's numbers; a call with no new steps
         since the previous summary writes nothing (no duplicates from
-        e.g. the keras Telemetry callback's train-end)."""
-        if not self._step_times or len(self._step_times) == self._last_summary_steps:
-            return
-        self._last_summary_steps = len(self._step_times)
-        import numpy as np
+        e.g. the keras Telemetry callback's train-end).
 
-        ts = np.asarray(self._step_times)
+        Percentiles come from the bounded step-time histogram (one-bucket
+        estimation error, ~1.78x width) instead of an unbounded list of
+        every step time — summary keys unchanged for existing readers."""
+        h = self._h_step
+        if h.count == 0 or h.count == self._last_summary_steps:
+            return
+        self._last_summary_steps = h.count
+        hd = h.to_dict()
         fields = {
-            "steps": int(len(ts)),
-            "p50_step_time_s": float(np.percentile(ts, 50)),
-            "p95_step_time_s": float(np.percentile(ts, 95)),
-            "mean_step_time_s": float(ts.mean()),
+            "steps": int(h.count),
+            "p50_step_time_s": percentile_from_hist(hd, 50),
+            "p95_step_time_s": percentile_from_hist(hd, 95),
+            "mean_step_time_s": h.sum / h.count,
             "examples_per_sec": (self._examples / self._train_seconds
                                  if self._train_seconds > 0 else 0.0),
         }
+        if self._flops_per_step and self._peak_flops and h.sum > 0:
+            # run-average MFU over measured train seconds
+            fields["mfu"] = (self._flops_per_step * h.count
+                             / (h.sum * self._peak_flops))
         if self._tokens > self._examples:
             fields["tokens_per_sec"] = (
                 self._tokens / self._train_seconds
@@ -181,6 +279,16 @@ class TelemetrySession:
     def close(self):
         if self._closed:
             return
+        # final snapshot: any run that produced metrics leaves a
+        # self-contained last metrics_snapshot record + metrics.prom
+        if _is_coordinator() and (
+                self.exporter is not None or self._h_step.count > 0
+                or len(self._registries) > 1):
+            try:
+                exp = self._get_exporter()
+                exp.stop(final_reason="final")
+            except Exception:
+                pass
         self.flush()
         self.recorder.close()
         self._closed = True

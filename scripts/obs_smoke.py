@@ -1,0 +1,176 @@
+"""ffpulse smoke: fit + serve with continuous export, then verify it all.
+
+The CI gate for the metrics plane (docs/observability.md "Metrics
+plane"): one small transformer LM on the virtual CPU mesh goes through
+
+  1. a short fit with --metrics-interval export on and the localhost
+     HTTP endpoint up (the script binds a free port itself — port 0
+     means OFF in config semantics), so rolling `metrics_snapshot`
+     records and an atomic `metrics.prom` land while training runs;
+  2. a live scrape of /metrics (must parse back through
+     parse_prometheus with the step-time histogram present) and
+     /healthz (must report the snapshot count) while the exporter
+     thread is still serving;
+  3. a shared-prefix serving trace through the SAME session, so the
+     drained snapshot carries the request-grain serving histograms
+     (queue wait / TTFT / TBT / e2e) next to the training goodput
+     gauges (tokens/s, train_mfu from the cost-model FLOPs anchor);
+  4. artifact verification from the files alone: every snapshot's
+     histogram bucket counts sum to its recorded total, the drained
+     snapshot's TTFT count equals the completed-with-token request
+     count, train_mfu is positive, and metrics.prom round-trips.
+
+ci.yml then runs scripts/run_doctor.py --check on the same dir — the
+doctor re-derives the snapshot identities from the artifacts with no
+help from this process.
+
+Usage: python scripts/obs_smoke.py --telemetry-dir OUT [flexflow flags]
+Exits nonzero with a diagnostic on any violated identity.
+"""
+
+import json
+import os
+import socket
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# virtual 8-device CPU mesh, exactly like tests/conftest.py
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def fail(msg: str):
+    print(f"obs_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerLMConfig, build_transformer_lm
+    from flexflow_tpu.telemetry import read_jsonl
+    from flexflow_tpu.telemetry.metrics import parse_prometheus
+
+    config = FFConfig()  # parses --telemetry-dir / --metrics-* from argv
+    if not config.telemetry_dir:
+        fail("pass --telemetry-dir")
+    if not config.metrics_interval:
+        config.metrics_interval = 0.2
+    if not config.metrics_port:
+        # port 0 means OFF in config semantics — the smoke must exercise
+        # the endpoint, so bind a free port here and hand it over
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        config.metrics_port = s.getsockname()[1]
+        s.close()
+
+    lm = TransformerLMConfig(
+        vocab_size=64, hidden_size=32, num_heads=4, num_layers=2,
+        sequence_length=32, attention_impl="xla")
+    batch = 8
+    config.batch_size = batch
+    ff = FFModel(config)
+    build_transformer_lm(ff, lm, batch_size=batch)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    # ---- leg 1: fit with export on ------------------------------------
+    rs = np.random.RandomState(0)
+    n = batch * 8  # 8 steps
+    toks = rs.randint(1, lm.vocab_size, (n, lm.sequence_length)).astype(
+        np.int32)
+    pos = np.tile(np.arange(lm.sequence_length, dtype=np.int32), (n, 1))
+    labels = rs.randint(0, lm.vocab_size,
+                        (n, lm.sequence_length, 1)).astype(np.int32)
+    ff.fit({"tokens": toks, "positions": pos}, labels,
+           epochs=1, batch_size=batch)
+
+    # ---- leg 2: scrape the live endpoint ------------------------------
+    base = f"http://127.0.0.1:{config.metrics_port}"
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            health = json.load(r)
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            prom_text = r.read().decode()
+    except OSError as e:
+        fail(f"metrics endpoint not serving on {base}: {e}")
+    if health.get("snapshots", 0) < 1:
+        fail(f"/healthz reports no snapshots: {health}")
+    scraped = parse_prometheus(prom_text)
+    if "train_step_time_s" not in scraped["histograms"]:
+        fail("/metrics scrape missing the train_step_time_s histogram")
+
+    # ---- leg 3: shared-prefix serving trace, same session -------------
+    engine = ff.serve(slots=2, max_new_tokens=4, prefill_chunk=4,
+                      kv_layout="paged", kv_block_size=4)
+    system = rs.randint(1, lm.vocab_size, 8).tolist()
+    prompts = [system] + [
+        system + rs.randint(1, lm.vocab_size, 4).tolist() for _ in range(5)]
+    outs = engine.generate(prompts)
+    if any(len(o) != 4 for o in outs):
+        fail(f"serving leg: expected 4 tokens per request, got "
+             f"{[len(o) for o in outs]}")
+
+    tel = ff.get_telemetry()
+    tel.close()
+
+    # ---- leg 4: verify the artifacts from the files alone -------------
+    tdir = config.telemetry_dir
+    recs = read_jsonl(os.path.join(tdir, "metrics.jsonl"))
+    snaps = [r for r in recs if r.get("kind") == "metrics_snapshot"]
+    if len(snaps) < 2:
+        fail(f"expected interval + final snapshots, got {len(snaps)}")
+    for r in snaps:
+        for key, h in (r["metrics"].get("histograms") or {}).items():
+            if sum(h["counts"]) != h["count"]:
+                fail(f"snapshot seq {r.get('seq')}: {key} bucket counts "
+                     f"sum to {sum(h['counts'])} but count is "
+                     f"{h['count']}")
+    final = snaps[-1]["metrics"]
+    hists = final.get("histograms") or {}
+    gauges = final.get("gauges") or {}
+    if hists.get("train_step_time_s", {}).get("count", 0) < 8:
+        fail(f"final snapshot missing the 8 fit steps: "
+             f"{hists.get('train_step_time_s')}")
+    if not gauges.get("train_mfu", 0) > 0:
+        fail(f"train_mfu gauge missing/zero (goodput anchor did not "
+             f"land): {gauges}")
+    drained = [r for r in snaps if r.get("drained")]
+    if not drained:
+        fail("no drained serving snapshot")
+    ttft = drained[-1]["metrics"]["histograms"].get("serve_ttft_s")
+    with_token = sum(1 for r in recs if r.get("kind") == "serve.request"
+                     and r.get("new_tokens", 0) > 0)
+    if ttft is None or ttft["count"] != with_token:
+        fail(f"drained snapshot TTFT count "
+             f"({ttft and ttft['count']}) != completed-with-token "
+             f"requests ({with_token})")
+
+    prom_path = os.path.join(tdir, "metrics.prom")
+    if not os.path.exists(prom_path):
+        fail("missing metrics.prom")
+    with open(prom_path) as f:
+        on_disk = parse_prometheus(f.read())
+    for name in ("train_step_time_s", "serve_ttft_s"):
+        if name not in on_disk["histograms"]:
+            fail(f"metrics.prom missing histogram {name}")
+
+    print(f"obs_smoke: OK — {len(snaps)} snapshots, "
+          f"scraped {len(prom_text.splitlines())} prom lines live, "
+          f"train_mfu={gauges['train_mfu']:.2e}, "
+          f"ttft_count={ttft['count']}, "
+          f"serve histograms exported: "
+          f"{sorted(k for k in on_disk['histograms'] if k.startswith('serve_'))}")
+
+
+if __name__ == "__main__":
+    main()

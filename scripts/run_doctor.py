@@ -131,6 +131,46 @@ def main():
                     problems.append(
                         f"elastic decision {i}: declined but the "
                         f"pay-off inequality holds ({lhs} < {rhs})")
+            # ffpulse gate: every metrics_snapshot must be self-
+            # consistent from the artifact alone — for each histogram
+            # the bucket counts must sum to the recorded total, and on a
+            # DRAINED serving snapshot the TTFT observation count must
+            # equal the completed-with-token request count (serve.request
+            # events with new_tokens > 0 since the last stats_reset —
+            # no_token requests are excluded from TTFT by design)
+            from flexflow_tpu.telemetry.recorder import read_jsonl
+
+            records = read_jsonl(
+                os.path.join(args.directory, "metrics.jsonl"))
+            snapshots = [r for r in records
+                         if r.get("kind") == "metrics_snapshot"]
+            for r in snapshots:
+                for key, h in (r.get("metrics", {})
+                               .get("histograms") or {}).items():
+                    if sum(h.get("counts", [])) != h.get("count"):
+                        problems.append(
+                            f"snapshot seq {r.get('seq')}: histogram "
+                            f"{key} bucket counts sum to "
+                            f"{sum(h.get('counts', []))} but count is "
+                            f"{h.get('count')}")
+            drained = [r for r in snapshots if r.get("drained")]
+            if drained:
+                last = drained[-1]
+                hists = last.get("metrics", {}).get("histograms") or {}
+                ttft = hists.get("serve_ttft_s")
+                window = []
+                for r in records:
+                    if r.get("kind") == "serve.stats_reset":
+                        window = []
+                    elif r.get("kind") == "serve.request":
+                        window.append(r)
+                with_token = sum(1 for r in window
+                                 if r.get("new_tokens", 0) > 0)
+                if ttft is not None and ttft.get("count") != with_token:
+                    problems.append(
+                        f"drained snapshot: serve_ttft_s count "
+                        f"({ttft.get('count')}) != completed-with-token "
+                        f"requests ({with_token})")
         if problems:
             print("run_doctor: CHECK FAILED: " + "; ".join(problems),
                   file=sys.stderr)

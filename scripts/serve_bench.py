@@ -7,14 +7,25 @@ with tunable load, for serving-focused profiling:
 
   python scripts/serve_bench.py [--requests N] [--slots S]
       [--prompt-len P] [--max-new-tokens T] [--shared-prefix K]
-      [--layout paged|contiguous|both] [--telemetry-dir DIR]
-      [flexflow flags]
+      [--arrival-rate R] [--burst B] [--layout paged|contiguous|both]
+      [--telemetry-dir DIR] [flexflow flags]
 
 --shared-prefix K (default: prompt-len // 2) prepends one K-token system
 prompt to every request — the N-users-one-system-prompt trace the paged
-layout's copy-on-write prefix sharing exists for. With --layout both
-(default) the same trace runs through both KV layouts and the report
-carries, next to each layout's req/s/chip:
+layout's copy-on-write prefix sharing exists for.
+
+--arrival-rate R > 0 switches from closed-loop (all requests queued up
+front, back-to-back stepping) to OPEN-loop load: requests arrive on a
+seeded Poisson process at R req/s, so queue wait and tail latency come
+from arrival pressure, not from the drain order — the load model tail
+percentiles are honest under. --burst B >= 1 modulates it: alternating
+windows of 8 arrivals have their inter-arrival gaps divided by B (a
+bursty trace at the same average rate). The report then carries
+TTFT/TBT/queue-wait p50/p95/p99 from the engine's mergeable histograms
+(engine.metrics_summary).
+
+With --layout both (default) the same trace runs through both KV layouts
+and the report carries, next to each layout's req/s/chip:
 
   - prefix_hit_rate / cow_copies (paged),
   - kv_hbm_bytes_per_layer resident per layout, and
@@ -50,9 +61,41 @@ def _pop_str(argv, flag, default):
     return default
 
 
-def run_trace(ff, layout, prompts, slots, max_new, **serve_kw):
-    """Drain `prompts` through a fresh engine of `layout`; returns
-    (completions, stats) with the measured window warmed + reset."""
+def _pop_float(argv, flag, default):
+    if flag in argv:
+        i = argv.index(flag)
+        val = float(argv[i + 1])
+        del argv[i:i + 2]
+        return val
+    return default
+
+
+def open_loop_offsets(n, rate, burst, rs):
+    """Seeded bursty-Poisson arrival offsets (seconds from window start):
+    exponential inter-arrival gaps at `rate` req/s, with every other
+    window of 8 arrivals compressed by `burst` — bursts at the same
+    long-run average rate, the trace shape TTFT p95 is judged under."""
+    import numpy as np
+
+    gaps = rs.exponential(1.0 / rate, size=n)
+    if burst > 1.0:
+        for i in range(n):
+            if (i // 8) % 2 == 0:
+                gaps[i] /= burst
+    return np.cumsum(gaps)
+
+
+def run_trace(ff, layout, prompts, slots, max_new, arrival_rate=0.0,
+              burst=1.0, **serve_kw):
+    """Run `prompts` through a fresh engine of `layout`; returns
+    (completions, metrics_summary) with the measured window warmed +
+    reset. arrival_rate > 0 drives the trace open-loop (submission by
+    wall clock on a seeded bursty-Poisson process); otherwise all
+    requests queue up front and the engine drains closed-loop."""
+    import time
+
+    import numpy as np
+
     kw = {"max_new_tokens": max_new, "kv_layout": layout, **serve_kw}
     if slots:
         kw["slots"] = slots
@@ -61,10 +104,33 @@ def run_trace(ff, layout, prompts, slots, max_new, **serve_kw):
     # steady state
     engine.generate(prompts[:1])
     engine.reset_stats()
-    for p in prompts:
-        engine.submit(p)
-    engine.run_until_drained()
-    return [r.generated for r in engine.scheduler.completed], engine.stats()
+    if arrival_rate > 0:
+        offsets = open_loop_offsets(
+            len(prompts), arrival_rate, burst, np.random.RandomState(7))
+        t0 = time.perf_counter()
+        i = 0
+        while i < len(prompts) or not engine.scheduler.drained:
+            now = time.perf_counter() - t0
+            while i < len(prompts) and offsets[i] <= now:
+                engine.submit(prompts[i])
+                i += 1
+            if engine.scheduler.drained:
+                # idle between bursts: sleep to the next arrival instead
+                # of spinning (open loop — the clock, not the engine,
+                # paces submissions)
+                time.sleep(max(0.0, offsets[i]
+                               - (time.perf_counter() - t0)))
+                continue
+            engine.step()
+        engine.note_drain(time.perf_counter() - t0)
+    else:
+        for p in prompts:
+            engine.submit(p)
+        engine.run_until_drained()
+    done = sorted(engine.scheduler.completed,
+                  key=lambda r: r.request_id)  # submission order: the
+    # cross-layout parity check must not depend on completion timing
+    return [r.generated for r in done], engine.metrics_summary()
 
 
 def main():
@@ -75,6 +141,8 @@ def main():
     max_new = _pop_int(argv, "--max-new-tokens", 16)
     shared_prefix = _pop_int(argv, "--shared-prefix", prompt_len // 2)
     kv_block_size = _pop_int(argv, "--kv-block-size", 0)
+    arrival_rate = _pop_float(argv, "--arrival-rate", 0.0)
+    burst = _pop_float(argv, "--burst", 1.0)
     layout = _pop_str(argv, "--layout", "both")
     sys.argv = [sys.argv[0]] + argv
     if not kv_block_size:
@@ -125,6 +193,7 @@ def main():
     for lay in layouts:
         completions[lay], results[lay] = run_trace(
             ff, lay, prompts, slots, max_new,
+            arrival_rate=arrival_rate, burst=burst,
             **(serve_kw if lay == "paged" else {}))
         print(json.dumps({
             "metric": f"serving_requests_per_sec_per_chip_{lay}",
@@ -132,6 +201,17 @@ def main():
                 results[lay].get("requests_per_sec_per_chip", 0.0), 4),
             "unit": "req/s",
         }))
+        # request-grain latency percentiles from the engine's mergeable
+        # histograms (present whenever the window saw the observation)
+        for short in ("ttft", "tbt", "queue_wait"):
+            for q in ("p50", "p95", "p99"):
+                key = f"{short}_{q}_s"
+                if key in results[lay]:
+                    print(json.dumps({
+                        "metric": f"serving_{short}_{q}_s_{lay}",
+                        "value": round(results[lay][key], 6),
+                        "unit": "s",
+                    }))
     if layout == "both" and completions["paged"] != completions["contiguous"]:
         print("serve_bench: FAIL — paged completions diverge from "
               "contiguous", file=sys.stderr)
@@ -139,6 +219,8 @@ def main():
 
     payload = {"shared_prefix": shared_prefix, "requests": n_requests,
                "prompt_len": prompt_len, "max_new_tokens": max_new,
+               "arrival_rate": arrival_rate, "burst": burst,
+               "load_model": "open" if arrival_rate > 0 else "closed",
                **{lay: results[lay] for lay in layouts}}
     if "paged" in results:
         st = results["paged"]

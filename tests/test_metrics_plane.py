@@ -1,0 +1,306 @@
+"""ffpulse metrics-plane tests (telemetry/metrics.py, telemetry/export.py,
+serving instrumentation, docs/observability.md "metrics plane").
+
+The acceptance surface of the mergeable metrics plane:
+
+  - bucket-estimated percentiles land within ONE bucket width of the
+    exact sample percentile (the log4 table's 10^0.25 ratio);
+  - merge_snapshots is associative and order-independent (the property
+    that makes coordinator-side cross-host merge well-defined);
+  - the Prometheus text exposition round-trips counters, gauges, and
+    histogram counts/sum/count through parse_prometheus;
+  - with telemetry OFF, a serving step allocates NO metric objects —
+    every series the hot path touches is pre-created at engine build
+    (the zero-cost-off overhead guard), and the module-level
+    inc/observe/set_gauge dispatchers are no-ops without a session;
+  - engine.metrics_summary() is callable MID-RUN, and at drain its
+    payload matches the serve.summary event bit for bit;
+  - `no_token_requests` pins the drain-accounting gap: requests that
+    have not produced a first token are counted there and excluded
+    from the TTFT histogram's denominator by design;
+  - the fflint `raw_timer_in_hot_path` rule catches a bare timer pair
+    in a step/decode/prefill function, stays quiet for gated reads,
+    pragma'd lines, non-hot-path functions, and telemetry/ files.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+
+def _lm_config():
+    from flexflow_tpu.models import TransformerLMConfig
+
+    return TransformerLMConfig(
+        vocab_size=64, hidden_size=32, num_heads=4, num_layers=2,
+        sequence_length=32, attention_impl="xla")
+
+
+def _build_lm(batch=1, argv=()):
+    sys.argv = ["test"] + list(argv)
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import build_transformer_lm
+
+    cfg = FFConfig()
+    if cfg.mesh_axis_sizes is None:
+        cfg.mesh_axis_sizes = (1, 1, 1, 1)
+    cfg.batch_size = batch
+    ff = FFModel(cfg)
+    build_transformer_lm(ff, _lm_config(), batch_size=batch)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+PROMPTS = [[3, 7, 11, 2, 5], [5, 2], [1, 9, 30, 30, 12, 4, 8], [60, 1, 2]]
+
+
+# ---------------------------------------------------------- pure registry
+
+
+def test_percentile_within_one_bucket_width():
+    """Bucket-estimated p50/p95/p99 over a lognormal sample sit within
+    one log4 bucket (ratio 10^0.25) of the exact sample percentile."""
+    from flexflow_tpu.telemetry.metrics import (
+        MetricsRegistry, percentile_from_hist,
+    )
+
+    rs = np.random.RandomState(11)
+    samples = rs.lognormal(mean=-4.0, sigma=1.0, size=2000)
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_s")
+    for v in samples:
+        h.observe(float(v))
+    hd = reg.snapshot()["histograms"]["lat_s"]
+    width = 10.0 ** 0.25  # one log4 bucket
+    for q in (50.0, 95.0, 99.0):
+        exact = float(np.percentile(samples, q))
+        est = percentile_from_hist(hd, q)
+        assert exact / width <= est <= exact * width, (
+            f"p{q}: estimate {est} more than one bucket from {exact}")
+
+
+def test_merge_associative_and_order_independent():
+    """merge over N simulated hosts gives one answer no matter the
+    grouping or order — counters/counts/sums add, min/max extremize."""
+    from flexflow_tpu.telemetry.metrics import (
+        MetricsRegistry, merge_snapshots,
+    )
+
+    rs = np.random.RandomState(3)
+    snaps = []
+    for host in range(3):
+        reg = MetricsRegistry()
+        c = reg.counter("train_tokens_total")
+        h = reg.histogram("train_step_time_s")
+        g = reg.gauge("slots_active", host=str(host))
+        for v in rs.lognormal(-2.0, 1.0, size=50 * (host + 1)):
+            h.observe(float(v))
+            c.inc(8.0)
+        g.set(float(host + 1))
+        snaps.append(reg.snapshot())
+
+    a = merge_snapshots(snaps)
+    b = merge_snapshots([merge_snapshots(snaps[:2]), snaps[2]])
+    c = merge_snapshots([snaps[2], snaps[0], snaps[1]])
+    assert a == b == c
+    hist = a["histograms"]["train_step_time_s"]
+    assert hist["count"] == 50 + 100 + 150
+    assert sum(hist["counts"]) == hist["count"]
+    assert a["counters"]["train_tokens_total"] == 8.0 * 300
+    # per-host labeled gauges survive as distinct series
+    assert a["gauges"]['slots_active{host="2"}'] == 3.0
+
+
+def test_prometheus_round_trip():
+    """to_prometheus -> parse_prometheus preserves counters, gauges, and
+    histogram counts/sum/count (min/max are not part of the exposition
+    format and are dropped by design)."""
+    from flexflow_tpu.telemetry.metrics import (
+        MetricsRegistry, parse_prometheus, to_prometheus,
+    )
+
+    reg = MetricsRegistry()
+    reg.counter("serve_tokens_out_total").inc(41.0)
+    reg.gauge("serve_slots_active", host="0").set(3.0)
+    h = reg.histogram("serve_ttft_s")
+    for v in (0.01, 0.02, 0.5, 1.7):
+        h.observe(v)
+    snap = reg.snapshot()
+    back = parse_prometheus(to_prometheus(snap))
+    assert back["counters"] == snap["counters"]
+    assert back["gauges"] == snap["gauges"]
+    want = snap["histograms"]["serve_ttft_s"]
+    got = back["histograms"]["serve_ttft_s"]
+    assert got["counts"] == want["counts"]
+    assert got["count"] == want["count"]
+    assert got["sum"] == pytest.approx(want["sum"])
+
+
+# ------------------------------------------------------- overhead guard
+
+
+def test_telemetry_off_step_allocates_no_metric_objects():
+    """With no telemetry session, draining a full trace creates ZERO new
+    series on the engine registry — every series the hot path touches is
+    pre-created at engine build — and the module-level dispatchers are
+    one-global-read no-ops."""
+    from flexflow_tpu import telemetry
+
+    assert telemetry._active is None
+    # module dispatchers: no session -> no-op, no error, no allocation
+    telemetry.inc("never_created_total")
+    telemetry.observe("never_created_s", 0.5)
+    telemetry.set_gauge("never_created", 1.0)
+
+    ff = _build_lm()
+    eng = ff.serve(slots=2, max_new_tokens=4, prefill_chunk=4)
+    n0 = len(eng.metrics)
+    for p in PROMPTS:
+        eng.submit(p)
+    while not eng.scheduler.drained:
+        eng.step()
+    assert len(eng.metrics) == n0, (
+        "serving steps allocated metric objects — the overhead guard "
+        "requires every hot-path series pre-created in __init__")
+    # the pre-created plane actually recorded the run
+    snap = eng.metrics.snapshot()
+    assert snap["histograms"]["serve_ttft_s"]["count"] == len(PROMPTS)
+    assert snap["counters"]["serve_tokens_generated_total"] == (
+        4.0 * len(PROMPTS))
+
+
+# ------------------------------------------------- summary + accounting
+
+
+def test_midrun_summary_matches_drain_summary(tmp_path):
+    """metrics_summary() works mid-run (old drain-only keys preserved),
+    and at drain the serve.summary event carries exactly the summary a
+    caller reads off the engine afterwards."""
+    ff = _build_lm()
+    ff.enable_telemetry(str(tmp_path / "tel"))
+    eng = ff.serve(slots=2, max_new_tokens=4, prefill_chunk=4)
+    for p in PROMPTS:
+        eng.submit(p)
+    for _ in range(3):
+        eng.step()
+    mid = eng.metrics_summary()  # mid-run: must not throw, old keys live
+    for key in ("requests_completed", "kv_layout", "no_token_requests"):
+        assert key in mid
+    assert mid["requests_completed"] <= len(PROMPTS)
+
+    import time
+
+    t0 = time.perf_counter()
+    while not eng.scheduler.drained:
+        eng.step()
+    eng.note_drain(time.perf_counter() - t0)
+    final = eng.metrics_summary()
+    eng.telemetry.close()
+
+    from flexflow_tpu.telemetry import read_jsonl
+
+    recs = read_jsonl(str(tmp_path / "tel" / "metrics.jsonl"))
+    summaries = [r for r in recs if r["kind"] == "serve.summary"]
+    assert summaries
+    event = summaries[-1]
+    for key, want in final.items():
+        assert key in event, f"serve.summary missing {key!r}"
+        if isinstance(want, float):
+            assert event[key] == pytest.approx(want), key
+        else:
+            assert event[key] == want, key
+    # drained snapshot landed with the self-consistency the doctor checks
+    drained = [r for r in recs if r.get("kind") == "metrics_snapshot"
+               and r.get("drained")]
+    assert drained
+    hists = drained[-1]["metrics"]["histograms"]
+    assert hists["serve_ttft_s"]["count"] == len(PROMPTS)
+    for h in hists.values():
+        assert sum(h["counts"]) == h["count"]
+
+
+def test_no_token_requests_excluded_from_ttft():
+    """Satellite pin: requests that have not yet produced a first token
+    are counted in stats()['no_token_requests'] and are NOT in the TTFT
+    histogram's denominator — submitted-but-unstepped requests show up
+    there, and after the drain the key returns to zero with TTFT count
+    equal to completed requests."""
+    ff = _build_lm()
+    eng = ff.serve(slots=2, max_new_tokens=4, prefill_chunk=4)
+    for p in PROMPTS:
+        eng.submit(p)
+    st = eng.stats()
+    assert st["no_token_requests"] == len(PROMPTS)
+    assert eng.metrics.snapshot()["histograms"]["serve_ttft_s"]["count"] == 0
+    while not eng.scheduler.drained:
+        eng.step()
+    st = eng.stats()
+    assert st["no_token_requests"] == 0
+    assert (eng.metrics.snapshot()["histograms"]["serve_ttft_s"]["count"]
+            == st["requests_completed"] == len(PROMPTS))
+
+
+# ----------------------------------------------------------- fflint rule
+
+
+_HOT = """
+import time
+
+def decode_step(batch):
+    t0 = time.perf_counter()
+    out = run(batch)
+    dt = time.perf_counter() - t0
+    return out, dt
+"""
+
+_GATED = """
+import time
+
+def decode_step(batch, tel):
+    if tel is not None:
+        t0 = time.perf_counter()
+    out = run(batch)
+    if tel is not None:
+        dt = time.perf_counter() - t0
+    return out
+"""
+
+_PRAGMA = """
+import time
+
+def decode_step(batch):
+    t0 = time.perf_counter()
+    out = run(batch)
+    dt = time.perf_counter() - t0  # fflint: ok raw_timer_in_hot_path
+    return out, dt
+"""
+
+_COLD = """
+import time
+
+def load_checkpoint(path):
+    t0 = time.perf_counter()
+    data = read(path)
+    return data, time.perf_counter() - t0
+"""
+
+
+def test_lint_raw_timer_in_hot_path_matrix():
+    from flexflow_tpu.analysis.lint import lint_source
+
+    def hits(src, path="flexflow_tpu/serving/engine.py"):
+        return [f for f in lint_source(src, path)
+                if f.code == "raw_timer_in_hot_path"]
+
+    found = hits(_HOT)
+    assert len(found) == 1
+    assert found[0].severity == "warning"
+    assert "decode_step" in found[0].message
+    # gated reads are the sanctioned idiom; pragma suppresses; a lone
+    # read is not a pair; cold-path names and telemetry/ files are out
+    assert hits(_GATED) == []
+    assert hits(_PRAGMA) == []
+    assert hits(_COLD) == []
+    assert hits(_HOT, path="flexflow_tpu/telemetry/session.py") == []
