@@ -60,7 +60,7 @@ _SEVERITIES = (SEV_ERROR, SEV_WARNING, SEV_INFO)
 #   lint (fflint rules): host_sync_in_loop, unsorted_dict_hash,
 #                        global_rng, time_in_trace,
 #                        unverified_transition, unverified_rule_load,
-#                        raw_timer_in_hot_path
+#                        raw_timer_in_hot_path, unnamed_op_scope
 
 
 @dataclass

@@ -69,6 +69,16 @@ Every rule encodes a bug class a past PR fixed by hand:
   r19 twin of `unverified_transition`; the built-in registry's own
   load sites are pragma'd because scripts/ffrules.py sweeps the full
   generated registry in CI.
+- `unnamed_op_scope` — an op-dispatch call (`*.op_def.forward` /
+  `*.op_def.backward`) in executor.py or ops/ with no lexically
+  enclosing `jax.named_scope(...)` block. The ffscope profiling plane
+  attributes trace events back to PCG nodes purely by named_scope
+  labels (scope/attribution.py) — a dispatch outside a scope produces
+  device time the attribution can only file as `unattributed_s`, so
+  the fidelity table silently loses that op. Dispatches that run under
+  a CALLER's named_scope (runtime nesting the AST cannot see, e.g. the
+  stage-3 remat closure invoked from the scoped forward loop) carry
+  the pragma.
 
 Suppression: a trailing `# fflint: ok` (optionally naming codes,
 `# fflint: ok host_sync_in_loop`) on the flagged line or its enclosing
@@ -92,7 +102,7 @@ ALL_RULES = ("host_sync_in_loop", "unsorted_dict_hash", "global_rng",
              "time_in_trace", "coordinator_collective", "donated_reuse",
              "low_precision_accum", "host_divergent_branch",
              "unverified_transition", "unverified_rule_load",
-             "raw_timer_in_hot_path")
+             "raw_timer_in_hot_path", "unnamed_op_scope")
 
 # identifiers whose presence in an `if` test marks the branch as a
 # telemetry/diagnostics gate (a gated fetch is the sanctioned pattern)
@@ -836,6 +846,49 @@ class _FileLint:
                 f"telemetry.span(...) or feed the delta to "
                 f"telemetry.observe(...) so it lands in the mergeable "
                 f"histograms", timer_reads=len(timers))
+
+    # ------------------------------------ rule: unnamed op scope
+
+    def rule_unnamed_op_scope(self):
+        # only where op dispatch lives: the executor's forward/backward
+        # paths and the ops/ package — the cost model's calibration
+        # harness times ops standalone (no trace to attribute) and is
+        # out of scope by construction
+        parts = os.path.normpath(self.path).split(os.sep)
+        if os.path.basename(self.path) != "executor.py" \
+                and "ops" not in parts:
+            return
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            d = _dotted(call.func)
+            if not (d.endswith(".op_def.forward")
+                    or d.endswith(".op_def.backward")
+                    or d in ("op_def.forward", "op_def.backward")):
+                continue
+            named = False
+            cur = self._parents.get(id(call))
+            while cur is not None:
+                if isinstance(cur, ast.With):
+                    for item in cur.items:
+                        ce = item.context_expr
+                        if isinstance(ce, ast.Call) and \
+                                _last_ident(ce.func) == "named_scope":
+                            named = True
+                if isinstance(cur, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    break  # runtime nesting is invisible past a def
+                cur = self._parents.get(id(cur))
+            if named:
+                continue
+            self._emit(
+                call, SEV_WARNING, "unnamed_op_scope",
+                f"{d}() dispatched outside jax.named_scope — its device "
+                f"time cannot be attributed back to the PCG node by the "
+                f"ffscope profiling plane (scope/attribution.py maps "
+                f"trace events via scope labels); wrap the dispatch in "
+                f"`with jax.named_scope(node.name):` (a dispatch that "
+                f"runs under a caller's scope is exempt: pragma it)")
 
     # ---------------------------------------------------------------- run
 

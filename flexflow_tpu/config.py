@@ -249,6 +249,18 @@ class FFConfig:
     # sampled step absorbs the drain the others skipped). Pipelined mode
     # gets every step's loss from the per-chunk vector regardless.
     health_sample_every: int = 1
+    # ffscope (flexflow_tpu/scope/): op-grain profiling plane, flight
+    # recorder, hang watchdog. --profile-every K captures every K-th
+    # step under jax.profiler and attributes device time back to PCG
+    # ops (the report's `profile` section); 0 = off (model.profile_step()
+    # still arms a one-shot). The watchdog fires when no step boundary
+    # lands within max(timeout, step-EMA x multiplier); 0 timeout = off.
+    profile_every: int = 0
+    watchdog_timeout: float = 0.0
+    watchdog_multiplier: float = 10.0
+    watchdog_abort: bool = False
+    # flight-recorder ring capacity (always on; 0 disables)
+    flight_events: int = 256
 
     def __post_init__(self):
         argv = sys.argv[1:]
@@ -491,6 +503,16 @@ class FFConfig:
                 self.spmd_barrier = True
             elif a == "--health-sample-every":
                 self.health_sample_every = int(val())
+            elif a == "--profile-every":
+                self.profile_every = int(val())
+            elif a == "--watchdog-timeout":
+                self.watchdog_timeout = float(val())
+            elif a == "--watchdog-multiplier":
+                self.watchdog_multiplier = float(val())
+            elif a == "--watchdog-abort":
+                self.watchdog_abort = True
+            elif a == "--flight-events":
+                self.flight_events = int(val())
             elif a == "--serve-slots":
                 self.serve_slots = int(val())
             elif a == "--serve-max-seq":

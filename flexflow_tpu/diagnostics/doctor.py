@@ -134,6 +134,19 @@ def diagnose(directory: str) -> dict:
             "counters": snap.get("counters", {}),
         }
 
+    # ffscope: the report's profile section (either source) and the
+    # flight record, when the run left one behind
+    profile = report.get("profile") if report else None
+    flight = _load_json(os.path.join(directory, "flight.json"))
+    watchdog = None
+    if flight is not None and flight.get("watchdog"):
+        watchdog = flight["watchdog"]
+    else:
+        wd_alerts = [a for a in alerts
+                     if a.get("rule") == "hang_watchdog"]
+        if wd_alerts:
+            watchdog = wd_alerts[-1]
+
     preempted = bool(by_kind.get("preempted"))
     resumed = bool(by_kind.get("resume"))
     errors = [a for a in alerts if a.get("level") == "error"]
@@ -173,6 +186,9 @@ def diagnose(directory: str) -> dict:
         "trace_spans": spans,
         "trace_dropped_events": dropped_events,
         "strategy_report": report,
+        "profile": profile,
+        "flight": flight,
+        "watchdog": watchdog,
     }
 
 
@@ -282,6 +298,54 @@ def render(d: dict) -> str:
                       "|---|---|"]
             for k, v in sorted(mp["counters"].items()):
                 lines.append(f"| {k} | {v:.0f} |")
+
+    prof = d.get("profile")
+    if prof:
+        # ONE measured-vs-predicted table for both sources: ffscope
+        # xplane attribution and --profiling standalone kernels land in
+        # the same section schema
+        lines += ["", "## Op profile (ffscope)", "",
+                  f"- source: `{prof.get('source', '?')}`  ·  step "
+                  f"{prof.get('step', '?')}  ·  attributed "
+                  f"{prof.get('attributed_s', 0.0) * 1e3:.3f} ms of "
+                  f"{prof.get('device_time_s', 0.0) * 1e3:.3f} ms device "
+                  f"time (parallelism x{prof.get('parallelism', 1)})",
+                  "",
+                  "| op | measured (ms) | predicted (ms) | fidelity |",
+                  "|---|---|---|---|"]
+        for o in sorted(prof.get("ops", []),
+                        key=lambda r: -r.get("measured_s", 0.0))[:10]:
+            pred = o.get("predicted_s")
+            fid = o.get("fidelity")
+            lines.append(
+                f"| {o['name']} | {o['measured_s'] * 1e3:.3f} "
+                + (f"| {pred * 1e3:.3f} " if pred is not None else "| — ")
+                + (f"| {fid:.2f} |" if fid is not None else "| — |"))
+
+    wd = d.get("watchdog")
+    if wd:
+        lines += ["", "## Hang watchdog (ffscope)", "",
+                  f"- FIRED: no step-boundary progress for "
+                  f"{wd.get('stalled_s', 0.0):.1f}s "
+                  f"(deadline {wd.get('deadline_s', 0.0):.1f}s, last step "
+                  f"{wd.get('last_step', '?')})",
+                  f"- lagging host: {wd.get('lagging_host', '?')}"]
+        for h in wd.get("hosts", []) or []:
+            lines.append(f"  - host {h.get('host')}: step "
+                         f"{h.get('step')} at t={h.get('time_unix')}")
+
+    fl = d.get("flight")
+    if fl:
+        lines += ["", "## Flight record (ffscope)", "",
+                  f"- reason: `{fl.get('reason', '?')}`  ·  "
+                  f"{len(fl.get('events', []))} event(s) of "
+                  f"{fl.get('total_recorded', 0)} recorded "
+                  f"(ring capacity {fl.get('capacity', '?')})  ·  last "
+                  f"step {fl.get('last_step', '?')}"]
+        tail = fl.get("events", [])[-5:]
+        if tail:
+            lines.append("- last events: " + ", ".join(
+                f"{e.get('kind')}:{e.get('name')}" for e in tail))
 
     if d["drift"]:
         dr = d["drift"]

@@ -41,6 +41,34 @@ class DriftAdvisory:
         }
 
 
+@dataclass
+class OpDriftAdvisory:
+    """Op-grain drift advisory (ffscope): one profiled step's measured
+    device time for ONE op deviated from its predicted cost beyond the
+    threshold — the targeted-recalibration trigger, so the response
+    refreshes exactly this op's calibration entry."""
+
+    step: int
+    op: str
+    predicted_s: float
+    measured_s: float
+    fidelity: float          # measured_s / predicted_s
+    threshold: float
+    message: str = ""
+
+    def to_record(self) -> dict:
+        return {
+            "rule": "costmodel_op_drift", "level": "warning",
+            "step": int(self.step),
+            "op": self.op,
+            "predicted_s": float(self.predicted_s),
+            "measured_s": float(self.measured_s),
+            "fidelity": float(self.fidelity),
+            "threshold": float(self.threshold),
+            "message": self.message,
+        }
+
+
 class DriftMonitor:
     """EMA drift detector over per-step (predicted, measured) pairs.
 
@@ -69,6 +97,11 @@ class DriftMonitor:
         self.samples = 0
         self.advisories: list[DriftAdvisory] = []
         self._armed = True
+        # ffscope op-grain state: advisories from profiled steps and the
+        # set of op names whose calibration entries await a targeted
+        # refresh (consumed by recalibrate_model(ops=...))
+        self.op_advisories: list[OpDriftAdvisory] = []
+        self.pending_op_refresh: set = set()
 
     def set_prediction(self, predicted_s: float):
         """Point the monitor at a fresh prediction (post-recalibration);
@@ -124,8 +157,53 @@ class DriftMonitor:
             self.recompile_state.alter()
         return adv
 
+    def note_profile(self, section: dict) -> list:
+        """Feed one profiled step's op-grain measurements (the report
+        ``profile`` section) and return the op advisories it produced.
 
-def recalibrate_model(model, top_k: int = 4) -> Optional[float]:
+        An op drifts when its fidelity (measured/predicted) deviates
+        from the step-level fidelity by more than the threshold — the
+        step-level baseline absorbs the global measured-vs-predicted
+        scale (a CPU mesh runs every op slower than the roofline by
+        roughly the same factor; what matters for *targeted* refresh is
+        the op whose ratio broke away from the pack). Drifted op names
+        accumulate in `pending_op_refresh` until a recalibration
+        consumes them."""
+        from .. import telemetry
+
+        step = int(section.get("step", 0))
+        rows = [r for r in section.get("ops", [])
+                if r.get("predicted_s") and r.get("measured_s", 0.0) > 0]
+        if not rows:
+            return []
+        fids = [r["measured_s"] / r["predicted_s"] for r in rows]
+        fids.sort()
+        baseline = fids[len(fids) // 2]  # median fidelity
+        if baseline <= 0:
+            return []
+        out = []
+        for r in rows:
+            fid = r["measured_s"] / r["predicted_s"]
+            rel = abs(fid - baseline) / baseline
+            if rel <= self.threshold:
+                continue
+            adv = OpDriftAdvisory(
+                step=step, op=r["name"],
+                predicted_s=float(r["predicted_s"]),
+                measured_s=float(r["measured_s"]),
+                fidelity=fid, threshold=self.threshold,
+                message=(f"op-grain drift: {r['name']} fidelity "
+                         f"{fid:.2f} vs step median {baseline:.2f} "
+                         f"(rel dev {rel:.2f} > {self.threshold:.2f})"))
+            self.op_advisories.append(adv)
+            self.pending_op_refresh.add(r["name"])
+            telemetry.instant("costmodel.op_drift.advisory",
+                              step=step, op=r["name"], fidelity=fid)
+            out.append(adv)
+        return out
+
+
+def recalibrate_model(model, top_k: int = 4, ops=None) -> Optional[float]:
     """Re-measure the plan's dominant ops on the local device
     (CostModel.calibrate_graph, remeasure=True) and refresh the model's
     predicted step makespan — the canonical drift response, shared by the
@@ -145,9 +223,23 @@ def recalibrate_model(model, top_k: int = 4) -> Optional[float]:
     if sr is None:
         return None
     us, choice = sr
-    # remeasure: the monitor fired BECAUSE the cached measurements no
-    # longer describe the device — refresh them, don't skip them
-    us.cm.calibrate_graph(model.graph, top_k=top_k, remeasure=True)
+    diag = getattr(model, "_diagnostics", None)
+    # ffscope targeted refresh: when the trigger was an op-grain
+    # advisory, only the drifted ops' entries are re-measured and
+    # persisted — undrifted ops keep their (still valid) measurements
+    if ops is None and diag is not None and diag.drift is not None \
+            and diag.drift.pending_op_refresh:
+        ops = sorted(diag.drift.pending_op_refresh)
+    refreshed_keys = None
+    if ops:
+        refreshed_keys = us.cm.calibrate_nodes(
+            model.graph, ops, remeasure=True)
+        if diag is not None and diag.drift is not None:
+            diag.drift.pending_op_refresh.difference_update(ops)
+    else:
+        # remeasure: the monitor fired BECAUSE the cached measurements
+        # no longer describe the device — refresh them, don't skip them
+        us.cm.calibrate_graph(model.graph, top_k=top_k, remeasure=True)
     us.cm._cache.clear()
     warm = getattr(model, "_warmstart", None)
     if warm is not None:
@@ -158,10 +250,12 @@ def recalibrate_model(model, top_k: int = 4) -> Optional[float]:
         from ..distributed import is_coordinator
 
         if is_coordinator():
-            warm.calibration_db.save_from(us.cm)
+            if refreshed_keys is not None:
+                warm.calibration_db.save_entries(us.cm, refreshed_keys)
+            else:
+                warm.calibration_db.save_from(us.cm)
     t, _ = us.evaluate(choice)
     model._predicted_step_s = t
-    diag = getattr(model, "_diagnostics", None)
     if diag is not None and diag.drift is not None:
         diag.drift.set_prediction(t)
     return t

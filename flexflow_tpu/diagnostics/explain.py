@@ -428,6 +428,37 @@ def render_markdown(report: dict) -> str:
                 f"ring all-gather, "
                 f"{report.get('param_gather_s', 0.0) * 1e3:.3f} ms "
                 f"issued one layer ahead (fwd + bwd re-gather)")
+    if report.get("profile"):
+        p = report["profile"]
+        lines += [
+            "",
+            "## Measured profile (ffscope)",
+            "",
+            f"- source: {p.get('source', '?')}  ·  step "
+            f"{p.get('step', '?')}  ·  device time "
+            f"{p.get('device_time_s', 0.0) * 1e3:.3f} ms  ·  attributed "
+            f"{p.get('attributed_s', 0.0) * 1e3:.3f} ms "
+            f"(parallelism x{p.get('parallelism', 1)}, "
+            f"slop {p.get('slop', 0.0):.2f})",
+            "",
+            "| op | measured (ms) | fwd (ms) | bwd (ms) "
+            "| predicted (ms) | fidelity |",
+            "|---|---|---|---|---|---|",
+        ]
+        for o in sorted(p.get("ops", []),
+                        key=lambda r: -r.get("measured_s", 0.0)):
+            pred = o.get("predicted_s")
+            fid = o.get("fidelity")
+            lines.append(
+                f"| {o['name']} | {o['measured_s'] * 1e3:.3f} "
+                f"| {o.get('fwd_s', 0.0) * 1e3:.3f} "
+                f"| {o.get('bwd_s', 0.0) * 1e3:.3f} "
+                + (f"| {pred * 1e3:.3f} " if pred is not None else "| — ")
+                + (f"| {fid:.2f} |" if fid is not None else "| — |"))
+        if p.get("extras"):
+            lines += ["", "runtime scopes: " + ", ".join(
+                f"{k} {v * 1e3:.3f} ms"
+                for k, v in sorted(p["extras"].items()))]
     lines += [
         "",
         "## Per-op attribution",
@@ -491,3 +522,15 @@ def write_strategy_report(model, directory: str) -> Optional[dict]:
     with open(os.path.join(directory, "strategy_report.md"), "w") as f:
         f.write(render_markdown(report))
     return report
+
+
+def rewrite_strategy_report(report: dict, directory: str) -> None:
+    """Atomically rewrite strategy_report.{json,md} from an updated
+    report dict (e.g. after ffscope attached a `profile` section)."""
+    jpath = os.path.join(directory, "strategy_report.json")
+    tmp = jpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+    os.replace(tmp, jpath)
+    with open(os.path.join(directory, "strategy_report.md"), "w") as f:
+        f.write(render_markdown(report))

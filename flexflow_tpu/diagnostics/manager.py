@@ -101,6 +101,37 @@ class DiagnosticsManager:
                     if self.elastic is not None:
                         self.elastic.on_advisory(adv)
 
+    def on_profile(self, section: dict):
+        """One profiled step's op-grain attribution (ffscope): annotate
+        with the plan's predictions, persist as the report's `profile`
+        section, feed the ffpulse registry, and let the drift monitor
+        derive op-grain advisories (the targeted-recalibration
+        trigger). Also the landing path for profiling.py's standalone
+        per-op numbers — one schema, two sources."""
+        from .. import telemetry
+        from ..scope.attribution import annotate_with_predictions
+        from .explain import rewrite_strategy_report
+
+        if self.report is not None:
+            annotate_with_predictions(section, self.report)
+            self.report["profile"] = section
+            rewrite_strategy_report(self.report, self.directory)
+        for row in section.get("ops", []):
+            if row.get("measured_s", 0.0) > 0:
+                telemetry.observe("op_time_s", row["measured_s"],
+                                  op=row["name"])
+        telemetry.event("profile", step=section.get("step"),
+                        source=section.get("source"),
+                        attributed_s=section.get("attributed_s"),
+                        device_time_s=section.get("device_time_s"))
+        # op-grain drift only from in-situ (xplane) captures: standalone
+        # kernels are timed unfused, so their fidelity says nothing
+        # about the entries the running plan was priced with
+        if self.drift is not None and section.get("source") == "xplane":
+            for adv in self.drift.note_profile(section):
+                self._alerts.record("advisory", **adv.to_record())
+                fflog.warning("diagnostics: %s", adv.message)
+
     def note_checkpoint_commit(self, t: Optional[float]):
         rule = self.health.rule("ckpt_stale")
         if rule is not None:

@@ -425,8 +425,11 @@ class Executor:
             weights = {}
             weights.update(self._cast_compute({**plain_p, **full}))
             weights.update(state_w)
-            return node.op_def.forward(node.params, list(ins_t), weights,
-                                       op_state_in, ctx)
+            # runs under the forward loop's `with jax.named_scope
+            # (node.name)` — the remat closure is invoked from inside
+            # that scope, so its trace events already carry the label
+            return node.op_def.forward(  # fflint: ok unnamed_op_scope
+                node.params, list(ins_t), weights, op_state_in, ctx)
 
         # prevent_cse=False: these regions only ever run inside jit
         # (the documented-safe case), and the CSE barriers would pin the
@@ -719,17 +722,21 @@ class Executor:
                     buckets=int(self.update_sharding.get("buckets", 0))):
                 with jax.named_scope("grad_sync"):
                     grads = self._pin_update_sharding(grads)
-        new_params, new_slots = self.optimizer.update(
-            grads, params, opt_slots, step
-        )
+        # named for ffscope attribution: optimizer math that belongs to
+        # no single PCG node lands in the profile section's extras map
+        with jax.named_scope("weight_update"):
+            new_params, new_slots = self.optimizer.update(
+                grads, params, opt_slots, step
+            )
         if self.update_specs:
             with jax.named_scope("weight_update_shard"):
                 new_params = self._pin_update_sharding(new_params)
                 new_slots = self._pin_update_sharding(new_slots)
-        counters = self.metrics.compute(
-            counters, logits, self.expand_labels(labels),
-            from_logits=not self.last_op_is_softmax, scce_sum=ce_sum,
-        )
+        with jax.named_scope("metrics"):
+            counters = self.metrics.compute(
+                counters, logits, self.expand_labels(labels),
+                from_logits=not self.last_op_is_softmax, scce_sum=ce_sum,
+            )
         return new_params, new_state, new_slots, step + 1, counters, lval
 
     def build_train_step(self):

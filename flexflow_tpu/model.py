@@ -1557,6 +1557,29 @@ class FFModel:
             return None
         return self.enable_diagnostics()
 
+    def _ensure_step_profiler(self):
+        """The model's ffscope StepProfiler (scope/profile.py), created
+        on first use from config (--profile-every; trace dirs live
+        under <telemetry-dir>/ffscope when a telemetry dir exists)."""
+        prof = getattr(self, "_scope_prof", None)
+        if prof is None:
+            import os
+
+            from .scope.profile import StepProfiler
+
+            root = (os.path.join(self.config.telemetry_dir, "ffscope")
+                    if self.config.telemetry_dir else None)
+            prof = self._scope_prof = StepProfiler(
+                every=self.config.profile_every, trace_root=root)
+        return prof
+
+    def profile_step(self):
+        """Arm a one-shot op-grain profile capture: the next fit step
+        runs under `jax.profiler` tracing and its attributed per-op
+        device time lands in strategy_report.json's `profile` section
+        (the programmatic twin of --profile-every K)."""
+        self._ensure_step_profiler().arm()
+
     def enable_elastic(self, **kwargs):
         """Attach the elastic re-planning controller (elastic/) to this
         model — the programmatic twin of --elastic. kwargs pass through
@@ -1697,15 +1720,59 @@ class FFModel:
             # the drift monitor now
             diag.on_compile()
         elastic = self._maybe_enable_elastic(diag)
+        # ffscope (scope/): flight-recorder sizing, sampled op-grain
+        # profiling, hang watchdog. The recorder itself is always on —
+        # config only resizes/disables the ring.
+        from .scope import flightrec
+        flightrec.configure(capacity=self.config.flight_events or None,
+                            enabled=self.config.flight_events > 0)
+        scope_prof = getattr(self, "_scope_prof", None)
+        if scope_prof is None and self.config.profile_every > 0:
+            scope_prof = self._ensure_step_profiler()
+        watchdog = None
+        if self.config.watchdog_timeout > 0:
+            from .scope.watchdog import HangWatchdog
+
+            try:
+                host_idx = jax.process_index()
+            except Exception:
+                host_idx = 0
+            wd_dir = (tel.directory if tel is not None
+                      else self.config.telemetry_dir
+                      or self.config.checkpoint_dir or None)
+
+            def _wd_alert(info, _diag=diag):
+                if _diag is not None:
+                    _diag._alerts.record(
+                        "alert", rule="hang_watchdog", level="error",
+                        step=info.get("last_step"),
+                        stalled_s=info.get("stalled_s"),
+                        deadline_s=info.get("deadline_s"),
+                        lagging_host=info.get("lagging_host"),
+                        message="hang watchdog fired: no step-boundary "
+                                "progress (flight.json dumped)")
+
+            watchdog = HangWatchdog(
+                timeout_s=self.config.watchdog_timeout,
+                multiplier=self.config.watchdog_multiplier,
+                directory=wd_dir, host_index=host_idx,
+                abort=self.config.watchdog_abort,
+                on_fire=_wd_alert).start()
         epoch_log = fflog.info if verbose else fflog.debug
         if self.config.profiling and not getattr(self, "_profiled", False):
             # --profiling: per-op kernel table, printed once per compile
             # (the reference prints per-kernel times every launch under
-            # m->profiling, linear_kernels.cu:95-117)
-            from .profiling import print_operator_profile
+            # m->profiling, linear_kernels.cu:95-117); the rows also land
+            # in the report's `profile` section (source: standalone) so
+            # the doctor renders one measured-vs-predicted table for both
+            # this and the ffscope xplane source
+            from .profiling import (print_operator_profile,
+                                    profile_section_from_rows)
 
-            print_operator_profile(self.graph)
+            rows = print_operator_profile(self.graph)
             self._profiled = True
+            if diag is not None and rows:
+                diag.on_profile(profile_section_from_rows(rows))
         if epochs < 0:
             epochs = self.config.epochs
         if batch_size < 0:
@@ -1784,6 +1851,15 @@ class FFModel:
         # per example (trailing size-1 dims collapse; plain (N, 1) labels
         # degenerate to 1 token = 1 example)
         tokens_per_example = int(np.prod(y.shape[1:])) if y.ndim > 1 else 1
+        # ffscope attribution joins trace scopes back to these names;
+        # the report's op set (when diagnostics wrote one) is the
+        # contract — every report op gets a measured column
+        prof_names = None
+        if scope_prof is not None:
+            if diag is not None and diag.report is not None:
+                prof_names = [o["name"] for o in diag.report["ops"]]
+            else:
+                prof_names = [n.name for n in self.graph.topo_order()]
 
         import contextlib
 
@@ -1842,6 +1918,7 @@ class FFModel:
                                 "preempted at step %d (chunk boundary): "
                                 "final checkpoint committed, stopping "
                                 "fit", py_step)
+                            flightrec.dump("sigterm")
                             return
                         b0_eager = num_batches  # epoch fully covered
                     else:
@@ -1857,6 +1934,10 @@ class FFModel:
                             data_wait = (time.perf_counter() - t_it0
                                          if tel is not None else 0.0)
                             self._rng, sub = jax.random.split(self._rng)
+                            capturing = (
+                                scope_prof is not None
+                                and scope_prof.should_capture(py_step + 1)
+                                and scope_prof.begin(py_step + 1))
                             (
                                 self._params,
                                 self._state,
@@ -1869,6 +1950,17 @@ class FFModel:
                                 self._step, self._counters, sub, batch,
                             )
                             py_step += 1
+                            if capturing:
+                                # drain before stop_trace so the step's
+                                # device work lands inside the capture
+                                jax.block_until_ready(self._params)
+                                section = scope_prof.end(
+                                    py_step, prof_names)
+                                if section is not None and diag is not None:
+                                    diag.on_profile(section)
+                            flightrec.note_step(py_step)
+                            if watchdog is not None:
+                                watchdog.beat(py_step)
                             # the cursor names the NEXT batch to run on
                             # resume; epochs are ABSOLUTE (since compile)
                             if b + 1 >= num_batches:
@@ -1965,6 +2057,7 @@ class FFModel:
                             fflog.warning(
                                 "preempted at step %d: final checkpoint "
                                 "committed, stopping fit", py_step)
+                            flightrec.dump("sigterm")
                             return
                     jax.block_until_ready(self._params)
                     dt = time.time() - t0
@@ -1981,6 +2074,7 @@ class FFModel:
                 # drain, no final save, and the in-flight async write must
                 # not commit after the "kill"; only checkpoints already
                 # committed at this instant survive for auto_resume
+                flightrec.dump("SimulatedPreemption")
                 if resil is not None:
                     resil.checkpointer.abort()
                 raise
@@ -1989,11 +2083,19 @@ class FFModel:
                 # training with artifacts intact. Drain the in-flight
                 # async save but do NOT final-snapshot — a NaN'd model is
                 # not worth committing over the last good checkpoint
+                flightrec.dump("HealthAbort")
                 if resil is not None:
                     resil.finalize()
                 fflog.error(
                     "fit aborted by diagnostics at step %d (see %s)",
                     py_step, diag.alerts_path if diag else "alerts.jsonl")
+                raise
+            except BaseException as e:
+                # anything else that kills the fit (executor exception,
+                # SPMDDivergenceError, the watchdog's interrupt) leaves
+                # the flight record behind — the post-mortem artifact a
+                # crash otherwise never writes
+                flightrec.dump(type(e).__name__)
                 raise
             else:
                 # the next fit() call continues the absolute epoch count
@@ -2002,6 +2104,10 @@ class FFModel:
                 if resil is not None:
                     resil.finalize()
             finally:
+                if watchdog is not None:
+                    watchdog.stop()
+                if scope_prof is not None:
+                    scope_prof.abandon()  # a capture left open by a raise
                 if tel is not None:
                     # artifacts must exist however fit ends (normal return,
                     # preemption, injected death): summary then trace dump.

@@ -60,6 +60,28 @@ def profile_operators_json(graph, rows=None) -> list[dict]:
     return out
 
 
+def profile_section_from_rows(rows) -> dict:
+    """Shape standalone per-op measurements into the SAME report
+    `profile` section schema ffscope's xplane attribution produces
+    (scope/attribution.py), so --profiling numbers land in
+    strategy_report.json / the ffpulse registry / the doctor's one
+    measured-vs-predicted table instead of a parallel one-off format.
+    `source: "standalone"` marks that these are unfused kernels timed
+    in isolation — the attribution identity (bounded by step device
+    time) applies only to `source: "xplane"` sections."""
+    from .scope.attribution import build_profile_section
+
+    ops = {name: {"measured_s": fwd + bwd, "fwd_s": fwd, "bwd_s": bwd,
+                  "events": 1}
+           for name, _op_type, fwd, bwd in rows}
+    attr = {"ops": ops, "extras": {},
+            "attributed_s": sum(o["measured_s"] for o in ops.values()),
+            "unattributed_s": 0.0, "parallelism": 1, "devices": 1}
+    return build_profile_section(
+        attr, step=-1, device_time_s=attr["attributed_s"],
+        source="standalone")
+
+
 def print_operator_profile(graph, file=None, sort_by_total=False):
     """Reference-format per-op table (linear_kernels.cu:95-117 prints
     '%s [Linear] forward time = %.2lfms'; this is the whole-graph sweep).
@@ -83,6 +105,9 @@ def print_operator_profile(graph, file=None, sort_by_total=False):
               f"backward time = {bwd * 1e3:.4f}ms", file=out)
         telemetry.counter(f"op_profile.{name}", {
             "forward_ms": fwd * 1e3, "backward_ms": bwd * 1e3})
+        # ffpulse: the same op_time_s{op=...} series the ffscope
+        # attribution feeds — one registry for both profile sources
+        telemetry.observe("op_time_s", fwd + bwd, op=name)
     total_f = sum(r[2] for r in rows)
     total_b = sum(r[3] for r in rows)
     print(f"TOTAL (sum of standalone kernels) forward = "

@@ -1,0 +1,38 @@
+"""ffscope — op-grain profiling, always-on flight recorder, hang watchdog.
+
+The op-grain runtime half of the observability stack.  Where ffpulse
+(telemetry/metrics.py) answers *how is the run doing* at step grain,
+ffscope answers *where did the time go and what happened last*:
+
+1. **Op-grain profiling** (profile.py, attribution.py, xplane.py) — a
+   sampled capture (``--profile-every K`` / ``model.profile_step()``)
+   wraps one step in ``jax.profiler`` tracing and maps measured device
+   time back to PCG nodes via the ``jax.named_scope(node.name)`` labels
+   the executor emits, producing per-op ``measured_s`` / fidelity next
+   to the strategy report's ``predicted_s`` — the attribution layer
+   Daydream (Zhu et al., USENIX ATC '20; see PAPERS.md) argues is what
+   makes a cost-model-driven system debuggable, here feeding op-grain
+   drift advisories so recalibration refreshes only the drifted ops.
+2. **Flight recorder** (flightrec.py) — an always-on bounded ring of
+   the last N telemetry events, dumped atomically as ``flight.json``
+   on crash, SIGTERM, or watchdog firing.
+3. **Hang watchdog** (watchdog.py) — a named daemon thread that
+   detects a stuck step, names the lagging host from a file-channel
+   heartbeat (never collectives), and optionally aborts.
+
+Import discipline: this package must stay importable without jax —
+the flight recorder hooks live inside ``telemetry`` dispatchers that
+run in every process; jax is imported lazily where tracing starts.
+"""
+
+from . import flightrec  # noqa: F401  (stdlib-only; safe eagerly)
+
+__all__ = ["flightrec", "attribution", "profile", "watchdog", "xplane"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        import importlib
+
+        return importlib.import_module("." + name, __name__)
+    raise AttributeError(name)

@@ -1,0 +1,241 @@
+"""Attribute profiled device time back to PCG ops.
+
+The executor wraps every op's forward dispatch in
+``jax.named_scope(node.name)`` (plus the ``grad_sync`` /
+``param_gather`` / ``weight_update_shard`` / ``weight_update`` runtime
+scopes), so each HLO instruction's ``OpMetadata.op_name`` carries a
+path like ``jit(train_step)/.../dense1/dot_general`` — or, for the
+backward pass, a path containing ``transpose(...)`` wrappers.  This
+module joins the two halves of an xplane capture:
+
+  * per-instruction device durations (``/host:CPU`` or device planes),
+  * per-instruction named-scope paths (``hlo_scope_map``),
+
+into the report's ``profile`` section: per-op ``measured_s`` next to
+the plan's ``predicted_s``, fidelity ratios, and the attribution
+identity the doctor re-verifies from the JSON alone:
+
+    attributed_s + unattributed_s == device_time_s * parallelism
+
+within a stated ``slop`` — where ``parallelism`` is the number of
+distinct trace lines that carried attributed events (a multi-threaded
+CPU backend or a multi-device mesh legitimately stacks more than one
+second of op time into one wall second).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from . import xplane
+
+__all__ = [
+    "attribute_trace",
+    "build_profile_section",
+    "annotate_with_predictions",
+    "verify_profile_section",
+    "RUNTIME_LABELS",
+]
+
+# Runtime scopes the executor emits that are not PCG node names.  They
+# are attributed into the section's ``extras`` map instead of ``ops``.
+RUNTIME_LABELS = ("grad_sync", "param_gather", "weight_update_shard",
+                  "weight_update", "metrics")
+
+# Identity slop: trace rounding is picosecond-exact but the step window
+# is measured with a host clock around dispatch; keep a generous but
+# stated tolerance so the identity is meaningful yet robust.
+DEFAULT_SLOP = 0.25
+
+
+def _match_label(path: str, op_names: Set[str]) -> Tuple[str, bool]:
+    """Map a named-scope path to (label, is_backward).
+
+    Walks path components from the end so the innermost matching scope
+    wins (an op nested under ``grad_sync`` attributes to the op).  The
+    backward pass shows up as ``transpose(...)`` wrappers in the path.
+    """
+    is_bwd = "transpose(" in path
+    # a component may be wrapped by tracer transforms — jit(f),
+    # jvp(dense1), transpose(jvp(dense1)) — so the label is the
+    # innermost piece: split on "(" and strip the closing parens
+    parts = [comp.split("(")[-1].rstrip(")")
+             for comp in path.split("/")]
+    for comp in reversed(parts):
+        if comp in op_names:
+            return comp, is_bwd
+    for comp in reversed(parts):
+        if comp in RUNTIME_LABELS:
+            return comp, is_bwd
+    return "", is_bwd
+
+
+def attribute_trace(trace_dir: str, op_names: Iterable[str],
+                    ) -> Dict[str, Any]:
+    """Parse every xplane file under ``trace_dir`` and attribute device
+    time to ``op_names`` + runtime labels.
+
+    Returns ``{"ops": {name: {"measured_s", "fwd_s", "bwd_s",
+    "events"}}, "extras": {label: seconds}, "attributed_s",
+    "unattributed_s", "trace_device_s", "parallelism", "devices"}``.
+    """
+    names = set(op_names)
+    ops: Dict[str, Dict[str, float]] = {}
+    extras: Dict[str, float] = {}
+    attributed = 0.0
+    unattributed = 0.0
+    lines_with_events: Set[Tuple[str, int]] = set()
+    device_planes = 0
+
+    for path in xplane.find_xplane_files(trace_dir):
+        space = xplane.parse_xspace(path)
+        scope_maps = xplane.hlo_scope_map(space)
+        for plane in space["planes"]:
+            pname = plane.get("name", "")
+            if "metadata" in pname or pname == "Task Environment":
+                continue
+            device_planes += 1
+            stat_names = plane.get("stat_metadata", {})
+            for line in plane.get("lines", []):
+                line_key = (pname, line.get("id", 0))
+                for ev in line.get("events", []):
+                    md = plane["event_metadata"].get(
+                        ev["metadata_id"], {})
+                    instr = md.get("name", "")
+                    stats = {}
+                    for st in ev.get("stats", []):
+                        key = stat_names.get(
+                            st.get("ref", st.get("metadata_id")))
+                        if key:
+                            stats[key] = st.get("value")
+                    pid = stats.get("program_id")
+                    dur_s = ev.get("duration_ps", 0) * 1e-12
+                    scope = None
+                    if pid is not None and pid in scope_maps:
+                        scope = scope_maps[pid].get(instr)
+                    elif len(scope_maps) == 1:
+                        scope = next(iter(scope_maps.values())).get(instr)
+                    if scope is None:
+                        # not an HLO-instruction event (runtime noise)
+                        continue
+                    lines_with_events.add(line_key)
+                    label, is_bwd = _match_label(scope, names)
+                    if not label:
+                        unattributed += dur_s
+                        continue
+                    attributed += dur_s
+                    if label in names:
+                        rec = ops.setdefault(label, {
+                            "measured_s": 0.0, "fwd_s": 0.0,
+                            "bwd_s": 0.0, "events": 0})
+                        rec["measured_s"] += dur_s
+                        rec["bwd_s" if is_bwd else "fwd_s"] += dur_s
+                        rec["events"] += 1
+                    else:
+                        extras[label] = extras.get(label, 0.0) + dur_s
+
+    return {
+        "ops": ops,
+        "extras": extras,
+        "attributed_s": attributed,
+        "unattributed_s": unattributed,
+        "parallelism": max(1, len(lines_with_events)),
+        "devices": max(1, device_planes),
+    }
+
+
+def build_profile_section(attr: Dict[str, Any], *, step: int,
+                          device_time_s: float,
+                          source: str = "xplane",
+                          all_op_names: Optional[Iterable[str]] = None,
+                          slop: float = DEFAULT_SLOP) -> Dict[str, Any]:
+    """Shape an :func:`attribute_trace` result (or standalone profiler
+    numbers in the same layout) into the report ``profile`` section.
+
+    Every name in ``all_op_names`` gets a row even when no event was
+    attributed to it (``measured_s == 0.0`` — e.g. fused away), so
+    downstream gates can rely on a measured column for every report op.
+    """
+    rows: List[Dict[str, Any]] = []
+    seen = set()
+    for name, rec in sorted(attr["ops"].items()):
+        rows.append({"name": name,
+                     "measured_s": rec["measured_s"],
+                     "fwd_s": rec.get("fwd_s", 0.0),
+                     "bwd_s": rec.get("bwd_s", 0.0),
+                     "events": rec.get("events", 0)})
+        seen.add(name)
+    for name in (all_op_names or ()):
+        if name not in seen:
+            rows.append({"name": name, "measured_s": 0.0, "fwd_s": 0.0,
+                         "bwd_s": 0.0, "events": 0})
+            seen.add(name)
+    return {
+        "source": source,
+        "step": step,
+        "device_time_s": device_time_s,
+        "devices": attr.get("devices", 1),
+        "parallelism": attr.get("parallelism", 1),
+        "slop": slop,
+        "attributed_s": attr.get("attributed_s", 0.0),
+        "unattributed_s": attr.get("unattributed_s", 0.0),
+        "ops": rows,
+        "extras": dict(attr.get("extras", {})),
+    }
+
+
+def annotate_with_predictions(section: Dict[str, Any],
+                              report: Dict[str, Any]) -> Dict[str, Any]:
+    """Attach per-op ``predicted_s`` and ``fidelity`` from a strategy
+    report's ``ops`` table.  ``fidelity = measured_s / predicted_s`` —
+    recomputable from the JSON alone, which is what run_doctor checks.
+    """
+    predicted = {o["name"]: float(o.get("compute_s", 0.0))
+                 + float(o.get("comm_s", 0.0))
+                 for o in report.get("ops", [])}
+    for row in section.get("ops", []):
+        p = predicted.get(row["name"])
+        if p is None:
+            continue
+        row["predicted_s"] = p
+        row["fidelity"] = (row["measured_s"] / p) if p > 0 else None
+    return section
+
+
+def verify_profile_section(section: Dict[str, Any]) -> List[str]:
+    """Re-verify the attribution identity from the JSON alone.
+
+    Returns a list of problem strings (empty == green).  Shared by
+    ``run_doctor --check`` and the tests.
+    """
+    problems: List[str] = []
+    ops = section.get("ops", [])
+    attributed = sum(float(o.get("measured_s", 0.0)) for o in ops)
+    attributed += sum(float(v) for v in
+                      section.get("extras", {}).values())
+    stated = (float(section.get("attributed_s", 0.0)))
+    tol = 1e-9 + 1e-6 * abs(stated)
+    if abs(attributed - stated) > tol:
+        problems.append(
+            "profile: sum of per-op measured_s %.9f != stated "
+            "attributed_s %.9f" % (attributed, stated))
+    budget = (float(section.get("device_time_s", 0.0))
+              * float(section.get("parallelism", 1))
+              * (1.0 + float(section.get("slop", DEFAULT_SLOP))))
+    total = attributed + float(section.get("unattributed_s", 0.0))
+    if section.get("source") == "xplane" and total > budget + 1e-9:
+        problems.append(
+            "profile: attributed+unattributed %.6fs exceeds device "
+            "budget %.6fs (device_time_s x parallelism x (1+slop))"
+            % (total, budget))
+    for o in ops:
+        p = o.get("predicted_s")
+        f = o.get("fidelity")
+        if p and f is not None:
+            want = float(o.get("measured_s", 0.0)) / float(p)
+            if abs(want - float(f)) > 1e-9 + 1e-6 * abs(want):
+                problems.append(
+                    "profile: op %s fidelity %.9f not recomputable "
+                    "(measured/predicted = %.9f)"
+                    % (o.get("name"), float(f), want))
+    return problems

@@ -1,0 +1,128 @@
+"""Sampled op-grain step capture (``--profile-every K`` /
+``model.profile_step()``).
+
+One profiled step wraps the step dispatch in
+``jax.profiler.start_trace``/``stop_trace``, then feeds the resulting
+``xplane.pb`` through :mod:`flexflow_tpu.scope.attribution` to produce
+the report ``profile`` section: per-op ``measured_s`` next to the
+plan's ``predicted_s``.  Captures are sampled (every K steps, or a
+one-shot armed by ``model.profile_step()``) because tracing a step is
+not free — the always-on layer is the flight recorder, not this.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, Iterable, Optional
+
+from . import attribution
+
+__all__ = ["StepProfiler"]
+
+
+class StepProfiler:
+    """Owns capture cadence + trace dirs for op-grain profiling."""
+
+    def __init__(self, every: int = 0, trace_root: Optional[str] = None,
+                 keep_traces: bool = False):
+        self.every = int(every)
+        self.trace_root = trace_root
+        self.keep_traces = bool(keep_traces)
+        self._armed = False           # one-shot via model.profile_step()
+        self._capturing: Optional[str] = None
+        self._t0 = 0.0
+        self._owns_root = False
+        self.last_section: Optional[Dict[str, Any]] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.every > 0 or self._armed
+
+    def arm(self) -> None:
+        """Request a one-shot capture of the next step."""
+        self._armed = True
+
+    def should_capture(self, step: int) -> bool:
+        if self._armed:
+            return True
+        # Skip step 0: it folds compile/warmup time into the capture.
+        return self.every > 0 and step > 0 and step % self.every == 0
+
+    # ---------------------------------------------------------- capture
+
+    def _root(self) -> str:
+        if self.trace_root is None:
+            self.trace_root = tempfile.mkdtemp(prefix="ffscope-")
+            self._owns_root = True
+        os.makedirs(self.trace_root, exist_ok=True)
+        return self.trace_root
+
+    def begin(self, step: int) -> bool:
+        """Start tracing one step.  Returns False when a trace is
+        already active (e.g. ``--xprof-dir`` wraps the whole fit) —
+        nested captures are not supported by the profiler."""
+        import jax
+
+        trace_dir = os.path.join(self._root(), "step%06d" % step)
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception:
+            self._armed = False
+            return False
+        self._capturing = trace_dir
+        self._t0 = time.perf_counter()
+        return True
+
+    def end(self, step: int, op_names: Iterable[str],
+            device_time_s: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """Stop tracing, attribute, and return the profile section.
+
+        ``device_time_s`` defaults to the wall-clock dispatch→blocked
+        window measured around the step (the caller blocked before
+        calling this).
+        """
+        import jax
+
+        trace_dir, self._capturing = self._capturing, None
+        self._armed = False
+        if trace_dir is None:
+            return None
+        wall_s = time.perf_counter() - self._t0
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            return None
+        if device_time_s is None:
+            device_time_s = wall_s
+        names = list(op_names)
+        try:
+            attr = attribution.attribute_trace(trace_dir, names)
+        finally:
+            if not self.keep_traces:
+                shutil.rmtree(trace_dir, ignore_errors=True)
+        section = attribution.build_profile_section(
+            attr, step=step, device_time_s=float(device_time_s),
+            source="xplane", all_op_names=names)
+        self.last_section = section
+        return section
+
+    def abandon(self) -> None:
+        """Stop a capture without attribution (step raised)."""
+        import jax
+
+        if self._capturing is None:
+            return
+        trace_dir, self._capturing = self._capturing, None
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        shutil.rmtree(trace_dir, ignore_errors=True)
+
+    def close(self) -> None:
+        self.abandon()
+        if self._owns_root and self.trace_root and not self.keep_traces:
+            shutil.rmtree(self.trace_root, ignore_errors=True)

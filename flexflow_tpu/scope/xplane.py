@@ -1,0 +1,309 @@
+"""Minimal stdlib decoder for XLA profiler ``xplane.pb`` captures.
+
+``jax.profiler.start_trace``/``stop_trace`` write one
+``<host>.xplane.pb`` per capture: a serialized ``XSpace`` protobuf.  We
+need two things out of it and nothing else, so rather than depending on
+TensorFlow (which owns the generated proto classes) this module
+hand-decodes the protobuf *wire format* — varints, length-delimited
+fields, and the two fixed widths — with ~60 lines of stdlib code:
+
+  * the ``/host:CPU`` (or ``/device:TPU:*``) planes' per-instruction
+    event durations, keyed by HLO instruction name + program id, and
+  * the ``/host:metadata`` plane's per-program ``Hlo Proto`` stat,
+    whose per-instruction ``OpMetadata.op_name`` carries the
+    ``jax.named_scope`` path (``jit(f)/jit(main)/dense1/dot_general``)
+    that attribution joins back to PCG nodes.
+
+Field numbers below follow tsl/profiler/protobuf/xplane.proto and
+xla/service/hlo.proto; they are stable wire contracts.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["parse_xspace", "hlo_scope_map", "find_xplane_files"]
+
+
+# ------------------------------------------------------------------ wire
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, Any]]:
+    """Yield ``(field_number, wire_type, value)`` triples from ``buf``.
+
+    Wire types: 0 varint (int), 1 fixed64 (bytes, 8), 2 length-delimited
+    (bytes), 5 fixed32 (bytes, 4).  Unknown/truncated data ends the
+    iteration rather than raising — profiler output sometimes trails
+    padding and we only ever need a known subset of fields.
+    """
+    i, n = 0, len(buf)
+    while i < n:
+        # key varint
+        key = 0
+        shift = 0
+        while True:
+            if i >= n:
+                return
+            b = buf[i]
+            i += 1
+            key |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        fnum, wt = key >> 3, key & 7
+        if wt == 0:
+            val = 0
+            shift = 0
+            while True:
+                if i >= n:
+                    return
+                b = buf[i]
+                i += 1
+                val |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+        elif wt == 1:
+            val = buf[i:i + 8]
+            i += 8
+        elif wt == 2:
+            ln = 0
+            shift = 0
+            while True:
+                if i >= n:
+                    return
+                b = buf[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            val = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            val = buf[i:i + 4]
+            i += 4
+        else:  # group / reserved: cannot skip safely
+            return
+        if i > n:
+            return
+        yield fnum, wt, val
+
+
+def _utf8(b: bytes) -> str:
+    return b.decode("utf-8", "replace")
+
+
+# ---------------------------------------------------------------- xplane
+
+def _parse_stat(buf: bytes) -> Dict[str, Any]:
+    # XStat: 1 metadata_id, 2 double, 3 uint64, 4 int64, 5 str, 6 bytes,
+    # 7 ref (index into plane stat_metadata)
+    st: Dict[str, Any] = {}
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 0:
+            st["metadata_id"] = v
+        elif f == 2 and wt == 1:
+            st["value"] = struct.unpack("<d", v)[0]
+        elif f == 3 and wt == 0:
+            st["value"] = v
+        elif f == 4 and wt == 0:
+            # zigzag not used here (int64 plain)
+            st["value"] = v
+        elif f == 5 and wt == 2:
+            st["value"] = _utf8(v)
+        elif f == 6 and wt == 2:
+            st["value"] = v
+        elif f == 7 and wt == 0:
+            st["ref"] = v
+    return st
+
+
+def _parse_event(buf: bytes) -> Dict[str, Any]:
+    # XEvent: 1 metadata_id, 2 offset_ps, 3 duration_ps, 4 stats
+    ev: Dict[str, Any] = {"metadata_id": 0, "offset_ps": 0,
+                          "duration_ps": 0, "stats": []}
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 0:
+            ev["metadata_id"] = v
+        elif f == 2 and wt == 0:
+            ev["offset_ps"] = v
+        elif f == 3 and wt == 0:
+            ev["duration_ps"] = v
+        elif f == 4 and wt == 2:
+            ev["stats"].append(_parse_stat(v))
+    return ev
+
+
+def _parse_line(buf: bytes) -> Dict[str, Any]:
+    # XLine: 1 id, 2 name, 3 timestamp_ns, 4 events, 11 display_name
+    line: Dict[str, Any] = {"id": 0, "name": "", "events": []}
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 0:
+            line["id"] = v
+        elif f == 2 and wt == 2:
+            line["name"] = _utf8(v)
+        elif f == 11 and wt == 2:
+            line["display_name"] = _utf8(v)
+        elif f == 4 and wt == 2:
+            line["events"].append(_parse_event(v))
+    return line
+
+
+def _parse_event_metadata(buf: bytes) -> Dict[str, Any]:
+    # XEventMetadata: 1 id, 2 name, 3 metadata (bytes), 4 display_name,
+    # 5 stats
+    md: Dict[str, Any] = {"id": 0, "name": "", "stats": []}
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 0:
+            md["id"] = v
+        elif f == 2 and wt == 2:
+            md["name"] = _utf8(v)
+        elif f == 3 and wt == 2:
+            md["metadata"] = v
+        elif f == 4 and wt == 2:
+            md["display_name"] = _utf8(v)
+        elif f == 5 and wt == 2:
+            md["stats"].append(_parse_stat(v))
+    return md
+
+
+def _parse_plane(buf: bytes) -> Dict[str, Any]:
+    # XPlane: 1 id, 2 name, 3 lines, 4 event_metadata map,
+    # 5 stat_metadata map, 6 stats
+    plane: Dict[str, Any] = {"id": 0, "name": "", "lines": [],
+                             "event_metadata": {}, "stat_metadata": {}}
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 0:
+            plane["id"] = v
+        elif f == 2 and wt == 2:
+            plane["name"] = _utf8(v)
+        elif f == 3 and wt == 2:
+            plane["lines"].append(_parse_line(v))
+        elif f == 4 and wt == 2:
+            # map<int64, XEventMetadata>: entry {1: key, 2: value}
+            key, val = 0, None
+            for ef, ewt, ev in _fields(v):
+                if ef == 1 and ewt == 0:
+                    key = ev
+                elif ef == 2 and ewt == 2:
+                    val = _parse_event_metadata(ev)
+            if val is not None:
+                plane["event_metadata"][key or val["id"]] = val
+        elif f == 5 and wt == 2:
+            # map<int64, XStatMetadata>: value {1: id, 2: name}
+            key, name = 0, ""
+            for ef, ewt, ev in _fields(v):
+                if ef == 1 and ewt == 0:
+                    key = ev
+                elif ef == 2 and ewt == 2:
+                    for sf, swt, sv in _fields(ev):
+                        if sf == 1 and swt == 0:
+                            key = key or sv
+                        elif sf == 2 and swt == 2:
+                            name = _utf8(sv)
+            plane["stat_metadata"][key] = name
+    return plane
+
+
+def parse_xspace(path: str) -> Dict[str, Any]:
+    """Parse an ``xplane.pb`` file into ``{"planes": [...]}``.
+
+    Each plane dict carries ``name``, ``lines`` (with resolved
+    ``events``: ``metadata_id``/``duration_ps``/``stats``),
+    ``event_metadata`` (id → {name, ...}) and ``stat_metadata``
+    (id → name).  Durations are picoseconds, per the xplane schema.
+    """
+    with open(path, "rb") as f:
+        buf = f.read()
+    planes: List[Dict[str, Any]] = []
+    for f_, wt, v in _fields(buf):
+        if f_ == 1 and wt == 2:
+            planes.append(_parse_plane(v))
+    return {"planes": planes}
+
+
+def find_xplane_files(trace_dir: str) -> List[str]:
+    """Locate ``*.xplane.pb`` files under a profiler trace directory."""
+    import os
+
+    out: List[str] = []
+    for root, _dirs, files in os.walk(trace_dir):
+        for name in files:
+            if name.endswith(".xplane.pb"):
+                out.append(os.path.join(root, name))
+    return sorted(out)
+
+
+# ------------------------------------------------------------- hlo proto
+
+def _parse_hlo_proto(buf: bytes) -> Dict[str, str]:
+    """Decode an ``HloProto`` blob into ``{instruction_name: op_name}``.
+
+    HloProto.f1 = HloModuleProto; HloModuleProto.f3 = repeated
+    HloComputationProto; HloComputationProto.f2 = repeated
+    HloInstructionProto {f1 name, f2 opcode, f7 OpMetadata{f2 op_name}}.
+    ``op_name`` is the ``jax.named_scope`` path XLA recorded for the
+    instruction (e.g. ``jit(f)/jit(main)/dense1/dot_general``).
+    """
+    scopes: Dict[str, str] = {}
+    for f, wt, module in _fields(buf):
+        if f != 1 or wt != 2:
+            continue
+        for mf, mwt, comp in _fields(module):
+            if mf != 3 or mwt != 2:
+                continue
+            for cf, cwt, instr in _fields(comp):
+                if cf != 2 or cwt != 2:
+                    continue
+                name, op_name = "", ""
+                for inf, inwt, iv in _fields(instr):
+                    if inf == 1 and inwt == 2:
+                        name = _utf8(iv)
+                    elif inf == 7 and inwt == 2:
+                        for of, owt, ov in _fields(iv):
+                            if of == 2 and owt == 2:
+                                op_name = _utf8(ov)
+                if name and op_name:
+                    scopes[name] = op_name
+    return scopes
+
+
+def hlo_scope_map(space: Dict[str, Any]) -> Dict[int, Dict[str, str]]:
+    """Extract ``{program_id: {instruction_name: named_scope_path}}``.
+
+    The ``/host:metadata`` plane stores one ``XEventMetadata`` per
+    compiled program, named ``<module>(<program_id>)``, whose stat
+    named ``Hlo Proto`` holds the serialized HloProto with per-
+    instruction OpMetadata.op_name scope paths.
+    """
+    out: Dict[int, Dict[str, str]] = {}
+    for plane in space.get("planes", []):
+        if "metadata" not in plane.get("name", ""):
+            continue
+        stat_names = plane.get("stat_metadata", {})
+        for md in plane.get("event_metadata", {}).values():
+            pid = _program_id_from_name(md.get("name", ""))
+            blob: Optional[bytes] = None
+            for st in md.get("stats", []):
+                ref = st.get("ref", st.get("metadata_id"))
+                if stat_names.get(ref) == "Hlo Proto" and isinstance(
+                        st.get("value"), bytes):
+                    blob = st["value"]
+            if blob is None:
+                continue
+            scopes = _parse_hlo_proto(blob)
+            if scopes:
+                out.setdefault(pid, {}).update(scopes)
+    return out
+
+
+def _program_id_from_name(name: str) -> int:
+    """``jit_f(5)`` → 5; names without an id map to 0."""
+    if name.endswith(")") and "(" in name:
+        inner = name[name.rfind("(") + 1:-1]
+        try:
+            return int(inner)
+        except ValueError:
+            return 0
+    return 0

@@ -807,6 +807,39 @@ class CostModel:
         }
         return measured
 
+    def calibrate_nodes(self, graph, names, remeasure: bool = True
+                        ) -> list:
+        """Re-measure exactly the named PCG ops (ffscope's targeted
+        drift response): an op-grain advisory knows WHICH op's
+        measurement went stale, so only that op's calibration entry is
+        refreshed — not the blanket top-K. Returns the `_params_key`s
+        actually refreshed (the calibration-DB entries to persist);
+        undrifted ops are never re-measured on this path."""
+        wanted = set(names)
+        refreshed: list = []
+        done: set = set()
+        for node in graph.topo_order():
+            if node.name not in wanted or node.op_type in _NON_COMPUTE:
+                continue
+            key = _params_key(node)
+            if key in done or (key in self._calibration
+                               and not remeasure):
+                continue
+            done.add(key)
+            try:
+                fn, args = _op_harness(node)
+                self.calibrate(node, fn, args)
+                refreshed.append(key)
+            except Exception:
+                continue
+        self.calib_stats = {
+            "measured": len(refreshed),
+            "cache_hits": 0,
+            "candidates": len(done),
+            "targeted": sorted(wanted),
+        }
+        return refreshed
+
     # ------------------------------------------- collective calibration
     # The ring/pipeline schedules are priced per ppermute hop; the analytic
     # machine model guesses that hop from datasheet ICI bandwidth. Like the

@@ -634,6 +634,67 @@ class ServingEngine:
         else:
             self._h_tbt.observe(req.last_token_t - prev_t)
 
+    @contextlib.contextmanager
+    def _maybe_xprof(self):
+        """--xprof-dir beyond fit: the serving step loop runs under the
+        same `jax.profiler.trace` passthrough the training loop gets
+        (model.py wraps fit), so decode/prefill show up in XProf and in
+        ffscope attribution. No-op without the flag; a trace already
+        active (e.g. a surrounding capture) wins without erroring."""
+        xdir = getattr(getattr(self.model, "config", None),
+                       "xprof_dir", None)
+        if not xdir:
+            yield
+            return
+        import jax
+
+        try:
+            jax.profiler.start_trace(xdir)
+        except Exception:
+            yield
+            return
+        try:
+            yield
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+    def profile_step(self) -> Optional[dict]:
+        """Capture ONE scheduler iteration under `jax.profiler` and
+        attribute its device time to the serving model's ops (ffscope) —
+        the serving twin of `model.profile_step()`. Returns the profile
+        section (also kept as `self.last_profile`), or None when the
+        capture could not start (e.g. a trace is already active)."""
+        import jax
+
+        from ..scope.profile import StepProfiler
+
+        prof = StepProfiler()
+        it = self._decode_iterations
+        if not prof.begin(it):
+            return None
+        try:
+            self.step()
+            jax.effects_barrier()
+        except BaseException:
+            prof.abandon()
+            raise
+        names = [n.name for n in self.model.graph.topo_order()] \
+            if getattr(self.model, "graph", None) is not None else []
+        section = prof.end(it, names)
+        prof.close()
+        if section is not None:
+            section["source"] = "serving"
+            with self._active():
+                for row in section["ops"]:
+                    if row["measured_s"] > 0:
+                        telemetry.observe("op_time_s", row["measured_s"],
+                                          op=row["name"])
+        self.last_profile = section
+        return section
+
     def run_until_drained(self, max_iterations: int = 0) -> list[Request]:
         """Iterate until queue and slots are empty; returns every request
         completed during the call. `max_iterations` > 0 bounds the loop
@@ -641,11 +702,12 @@ class ServingEngine:
         done: list[Request] = []
         t0 = time.perf_counter()
         it = 0
-        while not self.scheduler.drained:
-            done.extend(self.step())
-            it += 1
-            if max_iterations and it >= max_iterations:
-                break
+        with self._maybe_xprof():
+            while not self.scheduler.drained:
+                done.extend(self.step())
+                it += 1
+                if max_iterations and it >= max_iterations:
+                    break
         self.note_drain(time.perf_counter() - t0)
         return done
 

@@ -27,6 +27,12 @@ from .recorder import MetricsRecorder, read_jsonl
 from .session import TelemetrySession
 from .tracer import Tracer
 
+# ffscope flight recorder (scope/flightrec.py): stdlib-only, always-on
+# bounded ring fed from the dispatchers below.  Its own hot path is the
+# same one-global-read discipline — when disabled, _flight.record is a
+# global load + `is None` test.
+from ..scope import flightrec as _flight
+
 __all__ = [
     "Tracer", "MetricsRecorder", "MetricsRegistry", "TelemetrySession",
     "read_jsonl", "log",
@@ -76,6 +82,7 @@ def active_session() -> Optional[TelemetrySession]:
 # Hot-path helpers: cheap no-ops when no session is active.
 
 def span(name: str, **args):
+    _flight.record("span", name)
     s = _active
     if s is None:
         return _NOOP
@@ -83,12 +90,14 @@ def span(name: str, **args):
 
 
 def instant(name: str, **args):
+    _flight.record("instant", name)
     s = _active
     if s is not None:
         s.tracer.instant(name, **args)
 
 
 def counter(name: str, values: dict):
+    _flight.record("counter", name)
     s = _active
     if s is not None:
         s.tracer.counter(name, values)
@@ -96,6 +105,7 @@ def counter(name: str, values: dict):
 
 def event(kind: str, **fields):
     """Structured JSONL record into the active session's metrics log."""
+    _flight.record("event", kind, fields.get("step"))
     s = _active
     if s is not None:
         s.recorder.record(kind, **fields)

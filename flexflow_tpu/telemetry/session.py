@@ -33,6 +33,8 @@ _MANIFEST_CONFIG_FIELDS = (
     "diagnostics", "drift_threshold", "pipeline_steps",
     "health_sample_every", "warmstart_dir",
     "metrics_interval", "metrics_port",
+    "profile_every", "watchdog_timeout", "watchdog_multiplier",
+    "watchdog_abort", "flight_events",
 )
 
 

@@ -114,3 +114,35 @@ class CalibrationDB:
                 "warmstart: could not persist calibration DB %s: %s",
                 self.path, e)
             return None
+
+    def save_entries(self, cost_model, keys) -> Optional[int]:
+        """Persist ONLY the given `_params_key`s (merged over the
+        on-disk DB, atomic tmp+rename) — ffscope's targeted refresh:
+        an op-grain drift advisory re-measured one op, so exactly that
+        op's DB entry is rewritten and every other persisted entry is
+        left untouched. Coordinator-only, like save_from. Returns
+        entries written, or None on failure (warned, not raised)."""
+        try:
+            data = self._read()
+            dev = data.setdefault("devices", {}).setdefault(
+                device_key(), {})
+            written = 0
+            for key in keys:
+                val = cost_model._calibration.get(key)
+                if val is None:
+                    continue
+                dev[serialize_key(key)] = [float(val[0]), float(val[1])]
+                written += 1
+            if not written:
+                return 0
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = f"{self.path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)
+            return written
+        except OSError as e:
+            fflog.warning(
+                "warmstart: could not persist calibration entries %s: %s",
+                self.path, e)
+            return None

@@ -138,6 +138,51 @@ def main():
             # equal the completed-with-token request count (serve.request
             # events with new_tokens > 0 since the last stats_reset —
             # no_token requests are excluded from TTFT by design)
+            # ffscope gates: (a) when a profile section is present its
+            # attribution identity must re-verify from the JSON alone —
+            # per-op seconds sum back to attributed_s, attributed +
+            # unattributed bounded by step device time × parallelism
+            # within the stated slop, and every fidelity recomputable
+            # from its own measured/predicted pair; (b) a flight.json
+            # dump must be a well-formed bounded ring snapshot
+            prof = rep.get("profile")
+            if prof is not None:
+                from flexflow_tpu.scope.attribution import (
+                    verify_profile_section,
+                )
+
+                problems.extend(verify_profile_section(prof))
+            fpath = os.path.join(args.directory, "flight.json")
+            if os.path.exists(fpath):
+                try:
+                    flight = json.load(open(fpath))
+                except Exception as e:
+                    flight = None
+                    problems.append(f"flight.json does not parse: {e}")
+                if flight is not None:
+                    if flight.get("kind") != "flight_record":
+                        problems.append(
+                            f"flight.json kind is "
+                            f"{flight.get('kind')!r}, expected "
+                            f"'flight_record'")
+                    for key in ("reason", "capacity", "events"):
+                        if key not in flight:
+                            problems.append(f"flight.json missing {key!r}")
+                    events = flight.get("events")
+                    if isinstance(events, list):
+                        cap = flight.get("capacity")
+                        if isinstance(cap, int) and len(events) > cap:
+                            problems.append(
+                                f"flight.json holds {len(events)} events "
+                                f"but claims capacity {cap} — the ring "
+                                f"bound did not hold")
+                        for i, ev in enumerate(events):
+                            if not (isinstance(ev, dict) and "seq" in ev
+                                    and "kind" in ev and "name" in ev):
+                                problems.append(
+                                    f"flight.json event {i} malformed "
+                                    f"(needs seq/kind/name)")
+                                break
             from flexflow_tpu.telemetry.recorder import read_jsonl
 
             records = read_jsonl(

@@ -1,0 +1,159 @@
+"""ffscope smoke: profiled fit + injected stall, then verify artifacts.
+
+The CI gate for the op-grain observability plane (docs/observability.md
+"ffscope"): one small model on the virtual CPU mesh goes through a fit
+with
+
+  1. sampled op-grain profiling on (--profile-every 2): profiled steps
+     run under jax.profiler tracing and their attributed per-op device
+     time lands in strategy_report.json's `profile` section;
+  2. the hang watchdog armed (--watchdog-timeout) plus a fault hook
+     that stalls one step past the deadline, so the watchdog fires
+     mid-fit, dumps flight.json, and names the lagging host from the
+     file heartbeat channel;
+
+then verifies everything FROM THE ARTIFACTS ALONE:
+
+  - the profile section carries a measured column for every report op,
+    at least one op measured > 0, and the attribution identity
+    (Σ attributed ≤ step device time × parallelism within the stated
+    slop; fidelity recomputable from measured/predicted) re-verifies;
+  - flight.json parses, is a bounded ring dump (events ≤ capacity),
+    records reason "watchdog", and names the lagging host;
+  - alerts.jsonl carries the hang_watchdog alert;
+  - the markdown report renders the measured-vs-predicted table.
+
+ci.yml then runs scripts/run_doctor.py --check on the same dir — the
+doctor re-derives the attribution identity and flight-dump
+well-formedness independently.
+
+Usage: python scripts/scope_smoke.py --telemetry-dir OUT [flexflow flags]
+Exits nonzero with a diagnostic on any violated identity.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# virtual 8-device CPU mesh, exactly like tests/conftest.py
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+STALL_STEP = 5
+STALL_S = 2.0
+
+
+def fail(msg: str):
+    print(f"scope_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+    from flexflow_tpu.scope.attribution import verify_profile_section
+    from flexflow_tpu.telemetry import read_jsonl
+
+    config = FFConfig()  # parses --telemetry-dir / --profile-every etc.
+    if not config.telemetry_dir:
+        fail("pass --telemetry-dir")
+    if not config.profile_every:
+        config.profile_every = 2
+    if not config.watchdog_timeout:
+        config.watchdog_timeout = 0.8  # STALL_S must exceed this
+
+    ff = FFModel(config)
+    x = ff.create_tensor((32, 64))
+    t = ff.dense(x, 128, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 16)
+    t = ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+
+    def stall(step):
+        if step == STALL_STEP:
+            time.sleep(STALL_S)
+
+    ff.set_fault_hook(stall)
+    rs = np.random.RandomState(0)
+    n = 32 * 8  # 8 steps: captures at 2/4/6/8, stall at 5
+    ff.fit(rs.randn(n, 64).astype(np.float32),
+           rs.randint(0, 16, (n, 1)).astype(np.int32),
+           epochs=1, batch_size=32, verbose=False)
+    ff.get_telemetry().close()
+
+    tdir = config.telemetry_dir
+
+    # ---- profile section: per-op measured next to predicted -----------
+    rep = json.load(open(os.path.join(tdir, "strategy_report.json")))
+    prof = rep.get("profile")
+    if prof is None:
+        fail("strategy_report.json has no profile section "
+             "(--profile-every capture never landed)")
+    if prof.get("source") != "xplane":
+        fail(f"profile source {prof.get('source')!r}, expected 'xplane'")
+    rows = {r["name"]: r for r in prof["ops"]}
+    missing = [o["name"] for o in rep["ops"] if o["name"] not in rows]
+    if missing:
+        fail(f"report ops without a measured row: {missing}")
+    total_measured = sum(r["measured_s"] for r in prof["ops"])
+    if not total_measured > 0:
+        fail("no device time attributed to any op")
+    problems = verify_profile_section(prof)
+    if problems:
+        fail("attribution identity violated: " + "; ".join(problems))
+    with_fid = [r for r in prof["ops"]
+                if r.get("predicted_s") and r.get("fidelity")]
+    if not with_fid:
+        fail("no op carries a recomputable fidelity ratio")
+    md = open(os.path.join(tdir, "strategy_report.md")).read()
+    if "Measured profile (ffscope)" not in md:
+        fail("markdown report missing the measured profile table")
+
+    # ---- watchdog + flight record -------------------------------------
+    fpath = os.path.join(tdir, "flight.json")
+    if not os.path.exists(fpath):
+        fail("flight.json missing (watchdog never fired on the stall)")
+    flight = json.load(open(fpath))
+    if flight.get("kind") != "flight_record":
+        fail(f"flight.json kind {flight.get('kind')!r}")
+    if flight.get("reason") != "watchdog":
+        fail(f"flight reason {flight.get('reason')!r}, expected "
+             f"'watchdog'")
+    if len(flight["events"]) > flight["capacity"]:
+        fail(f"ring bound violated: {len(flight['events'])} events > "
+             f"capacity {flight['capacity']}")
+    wd = flight.get("watchdog") or {}
+    if wd.get("lagging_host") is None:
+        fail(f"watchdog dump does not name the lagging host: {wd}")
+    if not wd.get("stalled_s", 0) > config.watchdog_timeout:
+        fail(f"recorded stall {wd.get('stalled_s')}s under the "
+             f"{config.watchdog_timeout}s deadline")
+    alerts = read_jsonl(os.path.join(tdir, "alerts.jsonl"))
+    hang = [a for a in alerts if a.get("rule") == "hang_watchdog"]
+    if not hang:
+        fail("no hang_watchdog alert in alerts.jsonl")
+
+    print(f"scope_smoke: OK — {len(with_fid)} ops with fidelity "
+          f"(total measured {total_measured * 1e3:.2f} ms over "
+          f"{prof['parallelism']} lines), watchdog fired after "
+          f"{wd['stalled_s']:.2f}s stall naming host "
+          f"{wd['lagging_host']}, flight ring "
+          f"{len(flight['events'])}/{flight['capacity']} events")
+
+
+if __name__ == "__main__":
+    main()

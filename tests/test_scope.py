@@ -1,0 +1,653 @@
+"""ffscope observability plane: op-grain profiling, flight recorder,
+hang watchdog (scope/, docs/observability.md).
+
+Acceptance surface:
+
+  - the xplane wire decoder parses a hand-encoded XSpace (no TF
+    dependency) and attribution maps instruction durations back to PCG
+    node names through named-scope paths, fwd/bwd split included;
+  - a --profile-every fit produces a report `profile` section with a
+    measured column for every report op, the attribution identity
+    re-verifies from the JSON alone, and run_doctor --check enforces it;
+  - the flight recorder's ring bound holds, steady-state records
+    allocate no new slot objects (slot identity pinned), and a
+    HealthAbort fit leaves a well-formed flight.json behind;
+  - an injected stall fires the watchdog, which names the lagging host
+    from the file heartbeat channel and dumps a parseable flight.json;
+  - an injected single-op slowdown yields an op-grain drift advisory
+    and recalibration re-measures ONLY that op (0 re-measures for
+    undrifted ops — pinned by monkeypatched calibrate call counts);
+  - the fflint `unnamed_op_scope` rule flags bare op dispatch in
+    executor.py/ops/, honors named_scope wrapping + pragmas, and the
+    real executor sweeps clean.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import telemetry
+from flexflow_tpu.scope import flightrec
+from flexflow_tpu.scope.attribution import (
+    attribute_trace,
+    build_profile_section,
+    verify_profile_section,
+)
+from flexflow_tpu.scope.flightrec import FlightRecorder
+from flexflow_tpu.scope.watchdog import HangWatchdog, THREAD_NAME
+from flexflow_tpu.telemetry.recorder import read_jsonl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_session_leak():
+    yield
+    telemetry.deactivate()
+    # tests toggle the global flight recorder; restore the default
+    flightrec.configure(capacity=flightrec.DEFAULT_CAPACITY, enabled=True)
+
+
+# ------------------------------------------------------------- wire format
+
+def _vi(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _varint_field(fnum: int, v: int) -> bytes:
+    return _vi((fnum << 3) | 0) + _vi(v)
+
+
+def _ld(fnum: int, payload: bytes) -> bytes:
+    return _vi((fnum << 3) | 2) + _vi(len(payload)) + payload
+
+
+def _hlo_proto(instr_scopes: dict) -> bytes:
+    """{instruction_name: named_scope_path} → serialized HloProto."""
+    instrs = b""
+    for name, scope in instr_scopes.items():
+        op_meta = _ld(2, scope.encode())           # OpMetadata.op_name
+        instrs += _ld(2, _ld(1, name.encode())     # HloInstructionProto
+                      + _ld(7, op_meta))
+    comp = _ld(3, instrs)                          # HloComputationProto
+    return _ld(1, comp)                            # HloModuleProto
+
+
+def _xspace(instr_scopes: dict, durations_ps: dict,
+            program_id: int = 5) -> bytes:
+    """One metadata plane (Hlo Proto stat) + one /host:CPU plane whose
+    line carries an event per instruction with the given duration."""
+    # metadata plane: stat_metadata {1: "Hlo Proto"}, one XEventMetadata
+    # named "jit_f(<pid>)" whose stat ref=1 holds the HloProto bytes
+    hlo_stat = _varint_field(7, 1) + _ld(6, _hlo_proto(instr_scopes))
+    emd = (_varint_field(1, 7) + _ld(2, b"jit_f(%d)" % program_id)
+           + _ld(5, hlo_stat))
+    meta_plane = (_ld(2, b"/host:metadata")
+                  + _ld(4, _varint_field(1, 7) + _ld(2, emd))
+                  + _ld(5, _varint_field(1, 1)
+                        + _ld(2, _varint_field(1, 1)
+                              + _ld(2, b"Hlo Proto"))))
+    # device plane: stat_metadata {1: "program_id"}; event_metadata id i
+    # → instruction name; one line with one event per instruction
+    dev = _ld(2, b"/host:CPU")
+    events = b""
+    for i, (name, dur) in enumerate(durations_ps.items(), start=10):
+        dev += _ld(4, _varint_field(1, i)
+                   + _ld(2, _varint_field(1, i) + _ld(2, name.encode())))
+        pid_stat = _varint_field(7, 1) + _varint_field(3, program_id)
+        events += _ld(4, _varint_field(1, i) + _varint_field(3, dur)
+                      + _ld(4, pid_stat))
+    dev += _ld(3, _varint_field(1, 0) + events)    # XLine id 0
+    dev += _ld(5, _varint_field(1, 1)
+               + _ld(2, _varint_field(1, 1) + _ld(2, b"program_id")))
+    return _ld(1, meta_plane) + _ld(1, dev)
+
+
+@pytest.mark.quick
+def test_xplane_decode_and_attribution_synthetic(tmp_path):
+    """Hand-encoded XSpace bytes → per-op seconds: forward and backward
+    (transpose-wrapped) paths attribute to the op, runtime scopes land
+    in extras, unknown scopes in unattributed_s — and the built section
+    passes its own identity check."""
+    scopes = {
+        "dot.1": "jit(f)/jit(main)/jvp(dense1)/dot_general",
+        "dot.2": "jit(f)/jit(main)/transpose(jvp(dense1))/dot_general",
+        "add.3": "jit(f)/jit(main)/weight_update/add",
+        "mul.4": "jit(f)/jit(main)/somewhere_else/mul",
+    }
+    durs = {"dot.1": 2_000_000_000, "dot.2": 1_000_000_000,
+            "add.3": 500_000_000, "mul.4": 300_000_000}
+    d = tmp_path / "trace"
+    d.mkdir()
+    (d / "host.xplane.pb").write_bytes(_xspace(scopes, durs))
+
+    attr = attribute_trace(str(d), ["dense1", "dense2"])
+    op = attr["ops"]["dense1"]
+    assert op["fwd_s"] == pytest.approx(2e-3)
+    assert op["bwd_s"] == pytest.approx(1e-3)
+    assert op["measured_s"] == pytest.approx(3e-3)
+    assert op["events"] == 2
+    assert attr["extras"]["weight_update"] == pytest.approx(0.5e-3)
+    assert attr["unattributed_s"] == pytest.approx(0.3e-3)
+    assert attr["attributed_s"] == pytest.approx(3.5e-3)
+    assert attr["parallelism"] == 1
+
+    section = build_profile_section(
+        attr, step=7, device_time_s=4e-3, source="xplane",
+        all_op_names=["dense1", "dense2"])
+    # every requested op has a row, absent ones with measured 0
+    rows = {r["name"]: r for r in section["ops"]}
+    assert rows["dense2"]["measured_s"] == 0.0
+    assert verify_profile_section(section) == []
+    # break the identity: inflate device budget violation
+    bad = dict(section, device_time_s=1e-6, parallelism=1)
+    assert any("exceeds device budget" in p
+               for p in verify_profile_section(bad))
+
+
+@pytest.mark.quick
+def test_truncated_xplane_is_tolerated(tmp_path):
+    d = tmp_path / "trace"
+    d.mkdir()
+    buf = _xspace({"dot.1": "jit(f)/dense1/dot"}, {"dot.1": 10})
+    (d / "torn.xplane.pb").write_bytes(buf[: len(buf) // 2])
+    attr = attribute_trace(str(d), ["dense1"])  # no raise
+    assert attr["attributed_s"] >= 0.0
+
+
+# --------------------------------------------------------- flight recorder
+
+@pytest.mark.quick
+def test_flight_ring_bound_and_order():
+    rec = FlightRecorder(capacity=16)
+    for i in range(3 * 16 + 5):
+        rec.record("span", "op%d" % i, i)
+    snap = rec.snapshot()
+    assert len(snap) == 16                      # ring bound holds
+    seqs = [e["seq"] for e in snap]
+    assert seqs == sorted(seqs)                 # oldest-first
+    assert seqs[-1] == 3 * 16 + 5               # newest retained
+    assert snap[-1]["name"] == "op%d" % (3 * 16 + 4)
+
+
+@pytest.mark.quick
+def test_flight_zero_alloc_steady_state():
+    """Overhead guard: a steady-state record is index assignment into
+    preallocated slots — the slot objects (and the ring list) keep their
+    identity across thousands of records."""
+    rec = FlightRecorder(capacity=32)
+    ring_id = id(rec._ring)
+    slot_ids = [id(s) for s in rec._ring]
+    for i in range(10 * 32):
+        rec.record("span", "step", None)
+    assert id(rec._ring) == ring_id
+    assert [id(s) for s in rec._ring] == slot_ids
+
+
+@pytest.mark.quick
+def test_flight_dump_well_formed(tmp_path):
+    rec = FlightRecorder(capacity=8)
+    rec.note_step(3)
+    rec.record("instant", "alert.nan_loss", None)
+    rec.record("span", "obj", object())         # non-scalar → repr'd
+    path = rec.dump(str(tmp_path), "unit_test", extra={"k": 1})
+    doc = json.load(open(path))
+    assert doc["kind"] == "flight_record"
+    assert doc["reason"] == "unit_test"
+    assert doc["capacity"] == 8 and doc["last_step"] == 3
+    assert doc["k"] == 1
+    assert len(doc["events"]) <= doc["capacity"]
+    assert all("seq" in e and "kind" in e and "name" in e
+               for e in doc["events"])
+    json.dumps(doc)  # fully serializable (repr'd values included)
+
+
+@pytest.mark.quick
+def test_flight_module_plane_and_disable(tmp_path, monkeypatch):
+    # telemetry dispatchers feed the global recorder even with NO
+    # session active (the always-on contract)
+    flightrec.configure(capacity=64, enabled=True)
+    rec = flightrec.get_recorder()
+    before = rec._seq
+    telemetry.instant("x.y")
+    with telemetry.span("a.b"):
+        pass
+    assert rec._seq >= before + 2
+    # no directory resolvable → dump is skipped, never litters CWD
+    monkeypatch.delenv("FF_FLIGHT_DIR", raising=False)
+    assert flightrec.dump("nowhere") is None
+    monkeypatch.setenv("FF_FLIGHT_DIR", str(tmp_path))
+    assert flightrec.dump("env_dir") == str(tmp_path / "flight.json")
+    # disabled: every hook is a no-op and dump returns None
+    flightrec.configure(enabled=False)
+    telemetry.instant("dropped")
+    assert flightrec.get_recorder() is None
+    assert flightrec.dump("disabled") is None
+
+
+# ---------------------------------------------------------------- watchdog
+
+@pytest.mark.quick
+def test_watchdog_lagging_host_from_heartbeats():
+    hbs = [{"host": 0, "step": 7, "time_unix": 100.0},
+           {"host": 1, "step": 3, "time_unix": 120.0},
+           {"host": 2, "step": 7, "time_unix": 90.0}]
+    assert HangWatchdog.lagging_host(hbs) == 1    # lowest step wins
+    hbs[1]["step"] = 7
+    assert HangWatchdog.lagging_host(hbs) == 2    # then oldest beat
+    assert HangWatchdog.lagging_host([]) is None
+
+
+def test_watchdog_fires_on_stall_and_names_host(tmp_path):
+    """No beat within the deadline → one firing: flight.json dumped with
+    a watchdog section naming the lagging host (read from the file
+    heartbeat channel, which includes another host's stale file)."""
+    fired = []
+    wd = HangWatchdog(timeout_s=0.3, multiplier=10.0,
+                      directory=str(tmp_path), host_index=1,
+                      on_fire=fired.append, poll_interval_s=0.05)
+    # another host stopped beating at an older step
+    hb_dir = tmp_path / "heartbeats"
+    hb_dir.mkdir()
+    (hb_dir / "host-0.json").write_text(
+        json.dumps({"host": 0, "step": 1, "time_unix": time.time()}))
+    wd.start()
+    try:
+        wd.beat(4)
+        wd.beat(5)
+        deadline = time.time() + 5.0
+        while not fired and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        wd.stop()
+    assert wd.fired == 1 and fired
+    info = fired[0]
+    assert info["stalled_s"] > 0.3
+    assert info["last_step"] == 5
+    assert info["lagging_host"] == 0
+    assert {h["host"] for h in info["hosts"]} == {0, 1}
+    doc = json.load(open(tmp_path / "flight.json"))
+    assert doc["reason"] == "watchdog"
+    assert doc["watchdog"]["lagging_host"] == 0
+
+
+@pytest.mark.quick
+def test_watchdog_rearms_only_after_beat(tmp_path):
+    # multiplier=0: the fixed timeout governs even after the long first
+    # stall inflates the inter-beat EMA
+    wd = HangWatchdog(timeout_s=0.15, multiplier=0.0,
+                      directory=str(tmp_path), poll_interval_s=0.03)
+    wd.start()
+    try:
+        wd.beat(1)
+        time.sleep(0.6)
+        assert wd.fired == 1                     # fires ONCE per stall
+        wd.beat(2)                               # re-arms
+        time.sleep(0.5)
+        assert wd.fired == 2
+    finally:
+        wd.stop()
+    assert wd._thread is None
+    import threading
+
+    assert all(t.name != THREAD_NAME for t in threading.enumerate())
+
+
+# ------------------------------------------------------------ model e2e
+
+def _compiled_model(extra_argv=()):
+    sys.argv = ["test"] + list(extra_argv)
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+
+    config = FFConfig()
+    ff = FFModel(config)
+    x = ff.create_tensor((32, 64))
+    t = ff.dense(x, 128, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 16)
+    t = ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    return ff
+
+
+def _train_data(n=128, in_dim=64, classes=16):
+    rs = np.random.RandomState(0)
+    return (rs.randn(n, in_dim).astype(np.float32),
+            rs.randint(0, classes, (n, 1)).astype(np.int32))
+
+
+def _run_doctor(argv):
+    """Invoke scripts/run_doctor.py main() in-process (SystemExit on a
+    failed --check)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "run_doctor_under_test",
+        os.path.join(REPO, "scripts", "run_doctor.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    old = sys.argv
+    sys.argv = ["run_doctor"] + list(argv)
+    try:
+        mod.main()
+    finally:
+        sys.argv = old
+
+
+def test_profile_every_fit_attribution_and_doctor_gate(tmp_path):
+    """--profile-every: the report gains a `profile` section with a
+    measured column for every report op, the identity re-verifies from
+    the JSON alone (run_doctor --check), and tampering trips the gate."""
+    tdir = tmp_path / "tel"
+    ff = _compiled_model(["--telemetry-dir", str(tdir), "--diagnostics",
+                          "--profile-every", "2"])
+    x, y = _train_data()
+    ff.fit(x, y, epochs=1, batch_size=32, verbose=False)
+    telemetry.deactivate()
+
+    rep = json.load(open(tdir / "strategy_report.json"))
+    prof = rep["profile"]
+    assert prof["source"] == "xplane"
+    report_ops = {o["name"] for o in rep["ops"]}
+    rows = {r["name"]: r for r in prof["ops"]}
+    assert report_ops <= set(rows)               # a row for EVERY op
+    assert sum(r["measured_s"] for r in prof["ops"]) > 0
+    measured = [r for r in prof["ops"] if r["measured_s"] > 0]
+    assert all("fidelity" in r for r in measured if r.get("predicted_s"))
+    assert verify_profile_section(prof) == []
+    # markdown twin renders the measured table
+    md = (tdir / "strategy_report.md").read_text()
+    assert "Measured profile (ffscope)" in md
+    # ffpulse: op_time_s histograms landed in a metrics snapshot
+    recs = read_jsonl(tdir / "metrics.jsonl")
+    assert any(r.get("kind") == "profile" for r in recs)
+    snaps = [r for r in recs if r.get("kind") == "metrics_snapshot"]
+    assert any(
+        any(k.startswith("op_time_s") for k in
+            (s.get("metrics", {}).get("histograms") or {}))
+        for s in snaps)
+    # doctor renders one measured-vs-predicted table
+    from flexflow_tpu.diagnostics.doctor import diagnose, render
+
+    d = diagnose(str(tdir))
+    assert d["profile"] is not None
+    assert "Op profile (ffscope)" in render(d)
+    _run_doctor([str(tdir), "--check", "--out", str(tmp_path / "r.md")])
+    # tamper: a fidelity that no longer reproduces must trip the gate
+    for r in rep["profile"]["ops"]:
+        if r.get("fidelity"):
+            r["fidelity"] *= 3.0
+            break
+    json.dump(rep, open(tdir / "strategy_report.json", "w"))
+    with pytest.raises(SystemExit):
+        _run_doctor([str(tdir), "--check"])
+
+
+def test_health_abort_leaves_flight_record(tmp_path):
+    """Crash dump: a HealthAbort fit leaves a parseable flight.json
+    (reason=HealthAbort, ring sized by --flight-events) that
+    run_doctor --check validates — and rejects once malformed."""
+    tdir = tmp_path / "tel"
+    ff = _compiled_model(["--telemetry-dir", str(tdir), "--diagnostics",
+                          "--health-abort-on", "nan_loss",
+                          "--flight-events", "64"])
+    x, y = _train_data()
+    x[40, 3] = np.nan
+    from flexflow_tpu.diagnostics import HealthAbort
+
+    with pytest.raises(HealthAbort):
+        ff.fit(x, y, epochs=1, batch_size=32, verbose=False)
+    telemetry.deactivate()
+
+    doc = json.load(open(tdir / "flight.json"))
+    assert doc["kind"] == "flight_record"
+    assert doc["reason"] == "HealthAbort"
+    assert doc["capacity"] == 64
+    assert 0 < len(doc["events"]) <= 64
+    # the ring saw real telemetry traffic, ending near the abort
+    kinds = {e["kind"] for e in doc["events"]}
+    assert "step" in kinds or "span" in kinds
+    from flexflow_tpu.diagnostics.doctor import diagnose, render
+
+    d = diagnose(str(tdir))
+    assert d["flight"]["reason"] == "HealthAbort"
+    assert "Flight record (ffscope)" in render(d)
+    _run_doctor([str(tdir), "--check"])
+    doc["events"] = doc["events"] * 40            # breaks the ring bound
+    json.dump(doc, open(tdir / "flight.json", "w"))
+    with pytest.raises(SystemExit):
+        _run_doctor([str(tdir), "--check"])
+
+
+def test_injected_stall_fires_watchdog_in_fit(tmp_path):
+    """--watchdog-timeout + a fault hook that sleeps past the deadline:
+    the watchdog fires mid-fit, dumps flight.json with a watchdog
+    section naming the (single) host, and records a hang_watchdog
+    alert; the fit then completes normally."""
+    tdir = tmp_path / "tel"
+    ff = _compiled_model(["--telemetry-dir", str(tdir), "--diagnostics",
+                          "--watchdog-timeout", "0.6"])
+
+    def stall(step):
+        if step == 2:
+            time.sleep(1.8)
+
+    ff.set_fault_hook(stall)
+    x, y = _train_data()
+    ff.fit(x, y, epochs=1, batch_size=32, verbose=False)
+    telemetry.deactivate()
+
+    doc = json.load(open(tdir / "flight.json"))
+    assert doc["reason"] == "watchdog"
+    wd = doc["watchdog"]
+    assert wd["stalled_s"] > 0.6
+    assert wd["host"] == 0 and wd["lagging_host"] == 0
+    assert (tdir / "heartbeats" / "host-0.json").exists()
+    alerts = read_jsonl(tdir / "alerts.jsonl")
+    hang = [a for a in alerts if a.get("rule") == "hang_watchdog"]
+    assert hang and hang[0]["level"] == "error"
+    from flexflow_tpu.diagnostics.doctor import diagnose, render
+
+    d = diagnose(str(tdir))
+    assert d["watchdog"] is not None
+    assert "Hang watchdog (ffscope)" in render(d)
+
+
+# -------------------------------------------- targeted recalibration
+
+def test_op_drift_targeted_recalibration_refreshes_only_drifted_op(
+        tmp_path):
+    """Acceptance: an injected single-op slowdown yields an op-grain
+    advisory and recalibration re-measures ONLY that op — 0 re-measures
+    for undrifted ops, pinned by counting CostModel.calibrate calls."""
+    tdir = tmp_path / "tel"
+    ff = _compiled_model([
+        "--telemetry-dir", str(tdir), "--diagnostics", "--budget", "8",
+        "--enable-parameter-parallel", "--mesh", "4,2,1,1"])
+    diag = ff.get_diagnostics()
+    rep = diag.report
+    assert rep["mode"] == "searched" and diag.drift is not None
+
+    priced = [o for o in rep["ops"]
+              if o["compute_s"] + o["comm_s"] > 0]
+    assert len(priced) >= 3
+    slow_op = priced[1]["name"]
+    # synthesize a profiled step: every op at fidelity 2.0 except the
+    # injected one at 200x — only IT deviates from the step median
+    rows = []
+    for o in priced:
+        pred = o["compute_s"] + o["comm_s"]
+        scale = 200.0 if o["name"] == slow_op else 2.0
+        rows.append({"name": o["name"], "measured_s": pred * scale,
+                     "fwd_s": pred * scale, "bwd_s": 0.0, "events": 4})
+    section = {
+        "source": "xplane", "step": 9, "device_time_s": 1.0,
+        "devices": 1, "parallelism": 8, "slop": 0.25,
+        "attributed_s": sum(r["measured_s"] for r in rows),
+        "unattributed_s": 0.0, "ops": rows, "extras": {},
+    }
+    diag.on_profile(section)
+    assert diag.drift.pending_op_refresh == {slow_op}
+    assert [a.op for a in diag.drift.op_advisories] == [slow_op]
+    alerts = read_jsonl(tdir / "alerts.jsonl")
+    op_advs = [a for a in alerts if a.get("rule") == "costmodel_op_drift"]
+    assert [a["op"] for a in op_advs] == [slow_op]
+    # report persisted with the annotated profile section
+    rep2 = json.load(open(tdir / "strategy_report.json"))
+    assert rep2["profile"]["step"] == 9
+
+    from flexflow_tpu.diagnostics.drift import recalibrate_model
+
+    us, _choice = ff._search_result
+    measured = []
+    us.cm.calibrate = (lambda node, fn, args, **kw:
+                       (measured.append(node.name), (1e-4, 2e-4))[1])
+    t = recalibrate_model(ff)
+    assert t is not None
+    assert measured == [slow_op]                 # ONLY the drifted op
+    assert diag.drift.pending_op_refresh == set()
+    assert us.cm.calib_stats["targeted"] == [slow_op]
+    telemetry.deactivate()
+
+
+@pytest.mark.quick
+def test_standalone_profile_source_emits_no_op_drift():
+    """profiling.py's standalone kernels flow into the same schema but
+    must NOT trigger op-grain drift advisories (unfused timings say
+    nothing about in-situ pricing)."""
+    from flexflow_tpu.diagnostics.drift import DriftMonitor
+    from flexflow_tpu.profiling import profile_section_from_rows
+
+    rows = [("dense1", "OP_LINEAR", 1e-3, 2e-3),
+            ("dense2", "OP_LINEAR", 5e-4, 1e-3)]
+    section = profile_section_from_rows(rows)
+    assert section["source"] == "standalone"
+    assert {r["name"] for r in section["ops"]} == {"dense1", "dense2"}
+    assert verify_profile_section(section) == []
+    m = DriftMonitor(predicted_s=0.1)
+    # the manager gates note_profile on source == "xplane"; mimic it
+    if section.get("source") == "xplane":
+        m.note_profile(section)
+    assert m.op_advisories == [] and m.pending_op_refresh == set()
+
+
+# ------------------------------------------------------------- serving
+
+def test_serving_profile_step_and_xprof_dir(tmp_path):
+    """Satellite: the serving engine's step loop profiles under the same
+    plane — profile_step returns a `source: serving` section, and
+    --xprof-dir wraps run_until_drained in a jax.profiler trace that
+    leaves a dump behind."""
+    xdir = tmp_path / "xprof"
+    sys.argv = ["test", "--xprof-dir", str(xdir)]
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerLMConfig, build_transformer_lm
+
+    cfg = FFConfig()
+    if cfg.mesh_axis_sizes is None:
+        cfg.mesh_axis_sizes = (1, 1, 1, 1)
+    cfg.batch_size = 1
+    ff = FFModel(cfg)
+    build_transformer_lm(
+        ff, TransformerLMConfig(vocab_size=64, hidden_size=32,
+                                num_heads=4, num_layers=2,
+                                sequence_length=32, attention_impl="xla"),
+        batch_size=1)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    eng = ff.serve(slots=2, max_new_tokens=4, prefill_chunk=4)
+    eng.submit([3, 7, 11, 2])
+    eng.step()                                   # prefill underway
+    section = eng.profile_step()
+    assert section is not None
+    assert section["source"] == "serving"
+    assert section["ops"]                        # a row per graph op
+    assert verify_profile_section(section) == []
+    assert eng.last_profile is section
+    eng.run_until_drained()
+    assert xdir.exists() and any(os.scandir(xdir))  # xprof dump written
+
+
+# ----------------------------------------------------------------- lint
+
+def _lint(src, path="flexflow_tpu/executor.py"):
+    from flexflow_tpu.analysis.lint import lint_source
+
+    return [f for f in lint_source(src, path=path,
+                                   select=("unnamed_op_scope",))]
+
+
+@pytest.mark.quick
+def test_lint_unnamed_op_scope_matrix():
+    bare = (
+        "def fwd(node, ins):\n"
+        "    return node.op_def.forward(node.params, ins, {}, None, ctx)\n")
+    assert [f.code for f in _lint(bare)] == ["unnamed_op_scope"]
+    # wrapped in named_scope → clean
+    scoped = (
+        "def fwd(node, ins):\n"
+        "    with jax.named_scope(node.name):\n"
+        "        return node.op_def.forward(node.params, ins, {}, None,\n"
+        "                                   ctx)\n")
+    assert _lint(scoped) == []
+    # pragma'd (runtime nesting under a caller's scope) → clean
+    pragma = (
+        "def fwd(node, ins):\n"
+        "    return node.op_def.forward(  # fflint: ok unnamed_op_scope\n"
+        "        node.params, ins, {}, None, ctx)\n")
+    assert _lint(pragma) == []
+    # the scope must wrap THIS dispatch, not live past a def boundary
+    nested = (
+        "def outer(node, ins):\n"
+        "    with jax.named_scope(node.name):\n"
+        "        def run(t):\n"
+        "            return node.op_def.forward(node.params, t, {},\n"
+        "                                       None, ctx)\n"
+        "        return run(ins)\n")
+    assert [f.code for f in _lint(nested)] == ["unnamed_op_scope"]
+    # path gate: the calibration harness times ops standalone — exempt
+    assert _lint(bare, path="flexflow_tpu/search/cost_model.py") == []
+    assert [f.code for f in _lint(bare, path="flexflow_tpu/ops/core.py")
+            ] == ["unnamed_op_scope"]
+
+
+@pytest.mark.quick
+def test_lint_repo_sweep_clean():
+    """Every real op dispatch is scoped or carries a justified pragma."""
+    from flexflow_tpu.analysis.lint import lint_paths
+
+    findings = lint_paths(
+        [os.path.join(REPO, "flexflow_tpu")],
+        select=("unnamed_op_scope",))
+    assert findings == []
+
+
+# ---------------------------------------------------------------- config
+
+@pytest.mark.quick
+def test_config_flags_parse():
+    sys.argv = ["test", "--profile-every", "3", "--watchdog-timeout",
+                "5.5", "--watchdog-multiplier", "12", "--watchdog-abort",
+                "--flight-events", "128"]
+    from flexflow_tpu import FFConfig
+
+    cfg = FFConfig()
+    assert cfg.profile_every == 3
+    assert cfg.watchdog_timeout == 5.5
+    assert cfg.watchdog_multiplier == 12.0
+    assert cfg.watchdog_abort is True
+    assert cfg.flight_events == 128
