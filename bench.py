@@ -978,7 +978,117 @@ def _serving_legs(cfg, on_tpu: bool) -> dict:
         # (serving/engine.py stats() `kv_peak_vs_contiguous`)
         "slots_at_fixed_hbm": round(pst["kv_peak_vs_contiguous"], 4),
     }
+
+    # disaggregated leg (`serving.disagg` in the BENCH payload): the
+    # same shared-prefix trace through serve(disaggregate=True) — two
+    # Unity plans on disjoint sub-meshes at EQUAL total chips — next to
+    # the unified paged engine above: TTFT/TBT p50/p95 side by side,
+    # every KV handoff's measured-vs-predicted seconds, and the
+    # decode-side radix hit rate on a SECOND wave after a full drain
+    # with and without the cross-time cache (prefix_cache=False is the
+    # ablation: prefixes die with their last resident). Needs >= 2
+    # devices to split; a 1-chip run records why it skipped.
+    import jax
+
+    if jax.device_count() >= 2:
+        try:
+            out["disagg"] = _disagg_serving_leg(
+                ff, telemetry, sp, slots, max_new, block,
+                sorted(paged_eng.scheduler.completed,
+                       key=lambda r: r.request_id), pst)
+        except Exception as e:
+            out["disagg"] = {"skipped": f"{type(e).__name__}: {e}"}
+    else:
+        out["disagg"] = {"skipped": "single device — no chips to split"}
     return out
+
+
+def _disagg_serving_leg(ff, telemetry, prompts, slots, max_new, block,
+                        unified_done, unified_stats) -> dict:
+    """One `serving.disagg` payload: the shared-prefix trace through the
+    disaggregated engine, asserted bit-identical to the unified paged
+    drain (`unified_done`, sorted by request id), with the cross-time
+    radix ablation run on a separate prefix_cache=False engine."""
+
+    def wave(engine, tag):
+        engine.reset_stats()
+        for p in prompts:
+            engine.submit(p)
+        with telemetry.span("bench.serve.measure", leg=tag,
+                            requests=len(prompts)):
+            engine.run_until_drained()
+        done = sorted(engine.completed, key=lambda r: r.request_id)
+        return [r.generated for r in done], engine.metrics_summary()
+
+    dis = ff.serve(disaggregate=True, slots=slots, max_new_tokens=max_new,
+                   prefill_chunk=8, kv_block_size=block)
+    with telemetry.span("bench.serve.warmup", leg="disagg"):
+        dis.generate(prompts[:1])
+    done, dst = wave(dis, "disagg")
+    if done != [r.generated for r in unified_done]:
+        raise AssertionError(
+            "disaggregated completions diverge from the unified paged "
+            "engine on the shared-prefix trace")
+    fully_cached = sum(1 for h in dis.handoffs
+                       if h["injected_blocks"] == 0)
+    # second wave AFTER the full drain: every hit here crossed a drain
+    # boundary, i.e. came from the cross-time radix cache
+    _, dst2 = wave(dis, "disagg-wave2")
+    fully_cached += sum(1 for h in dis.handoffs
+                        if h["injected_blocks"] == 0)
+
+    # ablation: same engine shape, prefix_cache=False — the registry
+    # dies with its residents, so wave 2 restarts cold
+    nc = ff.serve(disaggregate=True, slots=slots, max_new_tokens=max_new,
+                  prefill_chunk=8, kv_block_size=block, prefix_cache=False)
+    with telemetry.span("bench.serve.warmup", leg="disagg-nocache"):
+        nc.generate(prompts[:1])
+    nc_done, _ = wave(nc, "disagg-nocache")
+    if nc_done != done:
+        raise AssertionError(
+            "prefix_cache=False completions diverge — the cross-time "
+            "cache changed tokens")
+    _, nst2 = wave(nc, "disagg-nocache-wave2")
+
+    leg = {
+        "prefill_chips": dis.prefill_chips,
+        "decode_chips": dis.decode_chips,
+        "kv_block_size": block,
+        "requests": len(prompts),
+        "requests_per_sec_per_chip": round(
+            dst.get("requests_per_sec_per_chip", 0.0), 4),
+        "unified_requests_per_sec_per_chip":
+            unified_stats.get("requests_per_sec_per_chip", 0.0),
+        # handoff plane: measured wall next to the fftrans prediction,
+        # summed over the measured wave (disagg_section carries the
+        # per-handoff records + verified programs in the strategy report)
+        "handoffs": dst.get("handoffs", 0) + dst2.get("handoffs", 0),
+        "fully_cached_handoffs": fully_cached,
+        "handoff_predicted_s": round(dst2.get("handoff_predicted_s", 0.0)
+                                     + dst.get("handoff_predicted_s", 0.0),
+                                     6),
+        "handoff_measured_s": round(dst2.get("handoff_measured_s", 0.0)
+                                    + dst.get("handoff_measured_s", 0.0),
+                                    6),
+        # post-drain wave hit rates: with the cross-time radix cache vs
+        # the prefix_cache=False ablation at identical load
+        "prefix_hit_rate_cross_time": round(
+            (dst2.get("decode") or {}).get("prefix_hit_rate", 0.0), 4),
+        "prefix_hit_rate_no_cross_time": round(
+            (nst2.get("decode") or {}).get("prefix_hit_rate", 0.0), 4),
+    }
+    # TTFT observes on the prefill side, TBT on the decode side; the
+    # unified engine's flat keys sit next to them for the equal-chips
+    # comparison
+    pre, dec = dst.get("prefill") or {}, dst.get("decode") or {}
+    for short, side in (("ttft", pre), ("queue_wait", pre), ("tbt", dec)):
+        for q in ("p50", "p95"):
+            key = f"{short}_{q}_s"
+            if key in side:
+                leg[key] = round(side[key], 6)
+            if key in unified_stats:
+                leg[f"unified_{key}"] = round(unified_stats[key], 6)
+    return leg
 
 
 def main():
@@ -1150,6 +1260,22 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
                 "value": serving["paged"]["slots_at_fixed_hbm"],
                 "prefix_hit_rate": serving["paged"]["prefix_hit_rate"],
                 "unit": "x contiguous",
+            }))
+        dg = serving.get("disagg") or {}
+        if "prefill_chips" in dg:
+            # the disaggregation headline: TTFT p95 at equal total chips
+            # vs the unified engine, and the cross-time radix ablation
+            print(json.dumps({
+                "metric": "serving_disagg_ttft_p95_s",
+                "value": dg.get("ttft_p95_s"),
+                "unified_ttft_p95_s": dg.get("unified_ttft_p95_s"),
+                "chips": f"{dg['prefill_chips']}p+{dg['decode_chips']}d",
+                "unit": "s",
+            }))
+            print(json.dumps({
+                "metric": "serving_disagg_prefix_hit_rate_cross_time",
+                "value": dg.get("prefix_hit_rate_cross_time"),
+                "no_cross_time": dg.get("prefix_hit_rate_no_cross_time"),
             }))
     except Exception as e:  # pragma: no cover - defensive
         print(f"bench: serving leg failed: {e}", file=sys.stderr)

@@ -42,6 +42,9 @@ BUILDER_CALLEES = {
     "build_eval_step": ("eval_fn", "_eval_step"),
     "build_decode_step": ("_step_fn", "_decode_step"),
     "build_block_copy": ("_copy_fn",),
+    # disaggregated serving's KV handoff landing: the decode-side pools
+    # are donated, so the coordinator rebinds the decode state
+    "build_kv_inject": ("_inject_fn",),
     # stage-3 (ZeRO-3/FSDP) full-gather of the sharded-at-rest param
     # tree: callers must rebind the donated tree (bench/smoke pattern)
     "build_param_gather": ("_gather_fn", "gather_fn"),

@@ -209,6 +209,24 @@ class FFConfig:
     serve_kv_layout: str = "paged"
     serve_kv_block_size: int = 16
     serve_kv_blocks: int = 0
+    # Cross-request radix prefix cache (serving/radix.py): cached prompt
+    # blocks outlive their residents under LRU eviction, so a recurring
+    # system prompt hits warm KV after a full drain. 0 restores
+    # live-residents-only sharing (the bench ablation).
+    serve_prefix_cache: int = 1
+    # Disaggregated serving (serving/disagg.py): prefill and decode run
+    # as two separately searched Unity plans on disjoint sub-meshes of
+    # the same device set (Orca / vLLM lineage: compute-bound prefill vs
+    # memory-bound decode want different layouts). serve_prefill_chips
+    # sizes the prefill sub-mesh (0 → half the devices); serve_role marks
+    # which side a decode-graph compile is for — it joins the warm-start
+    # plan fingerprint so the two plans cache independently.
+    serve_disaggregate: bool = False
+    serve_prefill_chips: int = 0
+    serve_role: str = ""  # "" | "prefill" | "decode"
+    # First device this mesh draws from jax.devices() — sub-meshes over
+    # disjoint device subsets (disaggregated serving) set it per side.
+    mesh_device_offset: int = 0
     # static plan verification (analysis/): the ffcheck pass pipeline —
     # sharding dataflow, memory liveness, collective uniformity,
     # donation/aliasing — runs at compile on EVERY plan source; errors
@@ -530,6 +548,12 @@ class FFConfig:
                 self.serve_kv_block_size = int(val())
             elif a == "--serve-kv-blocks":
                 self.serve_kv_blocks = int(val())
+            elif a == "--serve-prefix-cache":
+                self.serve_prefix_cache = int(val())
+            elif a == "--serve-disaggregate":
+                self.serve_disaggregate = True
+            elif a == "--serve-prefill-chips":
+                self.serve_prefill_chips = int(val())
             elif a == "--synthetic-input":
                 self.synthetic_input = True
             elif a == "--allow-tensor-op-math-conversion":

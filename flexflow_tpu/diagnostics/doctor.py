@@ -186,6 +186,7 @@ def diagnose(directory: str) -> dict:
         "trace_spans": spans,
         "trace_dropped_events": dropped_events,
         "strategy_report": report,
+        "serving_disagg": (report or {}).get("serving_disagg"),
         "profile": profile,
         "flight": flight,
         "watchdog": watchdog,
@@ -293,11 +294,51 @@ def render(d: dict) -> str:
                       "| gauge | value |", "|---|---|"]
             for k, v in sorted(pool.items()):
                 lines.append(f"| {k} | {v:.4g} |")
+        hits = sum(v for k, v in mp["counters"].items()
+                   if k.startswith("serve_prefix_cache_hits_total"))
+        misses = sum(v for k, v in mp["counters"].items()
+                     if k.startswith("serve_prefix_cache_misses_total"))
+        if hits or misses:
+            evict = sum(v for k, v in mp["counters"].items()
+                        if k.startswith(
+                            "serve_prefix_cache_evictions_total"))
+            cached = {k: v for k, v in mp["gauges"].items()
+                      if k.startswith("serve_prefix_cache_blocks")}
+            lines += ["", "### Radix prefix cache", "",
+                      f"- admissions: {hits + misses:.0f}  ·  hit rate "
+                      f"{hits / max(1.0, hits + misses):.1%}  ·  "
+                      f"evictions: {evict:.0f}"]
+            for k, v in sorted(cached.items()):
+                lines.append(f"- {k}: {v:.0f}")
         if mp["counters"]:
             lines += ["", "### Counters", "", "| counter | value |",
                       "|---|---|"]
             for k, v in sorted(mp["counters"].items()):
                 lines.append(f"| {k} | {v:.0f} |")
+
+    sd = d.get("serving_disagg")
+    if sd:
+        s = sd.get("summary") or {}
+        lines += ["", "## Disaggregated serving (KV handoff plane)", "",
+                  f"- chips: prefill {sd.get('prefill_chips', '?')} / "
+                  f"decode {sd.get('decode_chips', '?')}",
+                  f"- handoffs: {s.get('count', 0)} "
+                  f"({s.get('fully_cached', 0)} landed fully "
+                  f"radix-cached — zero rows moved)",
+                  f"- transfer seconds: predicted "
+                  f"{s.get('predicted_s', 0.0) * 1e3:.3f} ms, measured "
+                  f"{s.get('measured_s', 0.0) * 1e3:.3f} ms",
+                  f"- verified transfer programs: "
+                  f"{len(sd.get('programs') or {})} (distinct block "
+                  f"extents)"]
+        if sd.get("rebalances"):
+            last = sd["rebalances"][-1]
+            lines.append(
+                f"- last ratio decision: {last.get('decision')} "
+                f"({last.get('old_prefill_chips')}→"
+                f"{last.get('new_prefill_chips')} prefill chips, "
+                f"lhs {last.get('lhs_s', 0.0) * 1e3:.3f} ms vs rhs "
+                f"{last.get('rhs_s', 0.0) * 1e3:.3f} ms)")
 
     prof = d.get("profile")
     if prof:

@@ -358,6 +358,15 @@ def build_strategy_report(model) -> dict:
             "migrations": sum(1 for d in decisions
                               if d.get("decision") == "migrated"),
         }
+    disagg = getattr(model, "_serving_disagg", None)
+    if disagg is not None:
+        # disaggregated serving's KV handoff plane: every handoff's
+        # measured-vs-predicted plus the distinct verified fftrans
+        # transfer programs they reference — run_doctor --check
+        # recomputes each program's predicted_s from its own transfer
+        # entries (the same makespan-identity treatment the migration
+        # transition gets)
+        report["serving_disagg"] = disagg
     return report
 
 
@@ -412,6 +421,19 @@ def render_markdown(report: dict) -> str:
             lines.append(
                 f"  - step {d.get('step', '?')}: {d.get('trigger', '?')}"
                 f" → {d.get('decision', '?')}{side}")
+    if report.get("serving_disagg"):
+        sd = report["serving_disagg"]
+        s = sd.get("summary") or {}
+        lines.append(
+            f"- disaggregated serving: prefill "
+            f"{sd.get('prefill_chips', '?')} / decode "
+            f"{sd.get('decode_chips', '?')} chips, "
+            f"{s.get('count', 0)} KV handoff(s) "
+            f"({s.get('fully_cached', 0)} fully radix-cached), "
+            f"predicted {s.get('predicted_s', 0.0) * 1e3:.3f} ms vs "
+            f"measured {s.get('measured_s', 0.0) * 1e3:.3f} ms, "
+            f"{len(sd.get('programs') or {})} verified transfer "
+            f"program(s)")
     if report.get("update_sharding"):
         stage = report.get("update_stage", 2)
         lines.append(

@@ -854,6 +854,38 @@ class Executor:
             copy_blocks, donate_argnums=_donate_argnums((0,)))
         return self._copy_fn
 
+    def build_kv_inject(self):
+        """Disaggregated-serving handoff landing: write externally
+        computed KV rows (the prefill pool's blocks, host-staged by the
+        coordinator) into this engine's pool blocks in one donated
+        dispatch. `blocks` is the (B,) physical destination vector,
+        `rows_k`/`rows_v` are (layers, B, block_size, embed) stacked in
+        sorted pool-layer-name order — the same order the extraction
+        side reads, so layer i's rows land in layer i's pool. The engine
+        pads B to a power of two with (scratch, zero-rows) pairs, so the
+        executable set stays O(log blocks-per-prompt) like the COW copy
+        buckets. Donating `state` updates the pools in place on backends
+        with donation — a handoff costs block-sized DMAs, never a
+        pool-sized allocation."""
+
+        def inject_blocks(state, blocks, rows_k, rows_v):
+            new_state = {}
+            i = 0
+            for name in sorted(state):
+                nw = dict(state[name])
+                if "pool_k" in nw:
+                    nw["pool_k"] = nw["pool_k"].at[blocks].set(
+                        rows_k[i].astype(nw["pool_k"].dtype))
+                    nw["pool_v"] = nw["pool_v"].at[blocks].set(
+                        rows_v[i].astype(nw["pool_v"].dtype))
+                    i += 1
+                new_state[name] = nw
+            return new_state
+
+        self._inject_fn = jax.jit(
+            inject_blocks, donate_argnums=_donate_argnums((0,)))
+        return self._inject_fn
+
     def build_param_gather(self):
         """The stage-3 params' full gather as ONE donated executable:
         every sharded-at-rest leaf ring-gathered back to its compute

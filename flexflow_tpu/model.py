@@ -823,7 +823,7 @@ class FFModel:
         self.graph = g
 
         # --- mesh + strategy
-        self.mesh = build_mesh(self.config.mesh_shape())
+        self.mesh = self._build_mesh(self.config.mesh_shape())
         used_substitutions = False
         search_cost_model = None  # set by the search branch (calibrated)
         if self.config.warmstart_dir and self._warmstart is None:
@@ -971,7 +971,7 @@ class FFModel:
                     ms = self.config.mesh_shape()
                     sizes = {a: 1 for a in ms.axis_names}
                     sizes.update(plan_mesh_axes)
-                    self.mesh = build_mesh(MeshShape(
+                    self.mesh = self._build_mesh(MeshShape(
                         tuple(sizes[a] for a in ms.axis_names),
                         ms.axis_names))
                 self._strategy = overrides
@@ -1087,7 +1087,7 @@ class FFModel:
                         machine_factory=machine_factory)
                 sizes = {a: 1 for a in ms.axis_names}
                 sizes.update(shape)
-                self.mesh = build_mesh(MeshShape(
+                self.mesh = self._build_mesh(MeshShape(
                     tuple(sizes[a] for a in ms.axis_names), ms.axis_names))
                 self.graph = g
                 self._strategy = us.to_strategy(choice).overrides
@@ -2248,6 +2248,21 @@ class FFModel:
 
         return SingleDataLoader(self, batch_tensor, full_array)
 
+    def _build_mesh(self, shape):
+        """Build this model's mesh, honouring `mesh_device_offset`: a
+        nonzero offset carves the mesh out of jax.devices()[offset:], so
+        two compiles with disjoint (offset, shape) windows place on
+        disjoint chips — the disaggregated serving sub-meshes."""
+        off = int(getattr(self.config, "mesh_device_offset", 0) or 0)
+        devices = jax.devices()
+        if off:
+            if off >= len(devices):
+                raise ValueError(
+                    f"mesh_device_offset {off} >= device count "
+                    f"{len(devices)}")
+            devices = devices[off:]
+        return build_mesh(shape, devices=devices)
+
     # ------------------------------------------------ serving (serving/)
 
     def serve(self, **kwargs):
@@ -2261,8 +2276,22 @@ class FFModel:
         interleaved with decode) by default (docs/serving.md). kwargs
         override ServingSpec fields — slots, max_seq_len, prefill_chunk,
         kv_layout ("paged"|"contiguous"), kv_block_size, kv_num_blocks,
-        prefix_sharing, config_overrides, strategy, ..."""
+        prefix_sharing, config_overrides, strategy, ...
+
+        `disaggregate=True` (or --serve-disaggregate) instead builds a
+        DisaggregatedServingEngine: prefill and decode compile as TWO
+        independent Unity plans on disjoint sub-meshes (serve_prefill_chips
+        sizes the prefill side), with each request's KV handed off
+        through a verified, priced fftrans transfer program
+        (docs/serving.md "Disaggregated serving")."""
         assert self._compiled, "call compile() before serve()"
+        disaggregate = kwargs.pop(
+            "disaggregate",
+            bool(getattr(self.config, "serve_disaggregate", False)))
+        if disaggregate:
+            from .serving import DisaggregatedServingEngine
+
+            return DisaggregatedServingEngine(self, **kwargs)
         from .serving import ServingEngine
 
         return ServingEngine(self, **kwargs)

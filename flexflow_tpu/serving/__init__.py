@@ -18,12 +18,15 @@ interleaved with the in-flight decodes.
 """
 
 from .decode_graph import ServingSpec, adopt_params, build_decode_model
+from .disagg import DisaggregatedServingEngine
 from .engine import ServingEngine
 from .paged import BlockManager, CopyPlan, PagedStats
+from .radix import RadixPrefixCache
 from .scheduler import ContinuousBatchingScheduler, Request, Slot
 
 __all__ = [
-    "ServingEngine", "ServingSpec", "Request", "Slot",
+    "ServingEngine", "DisaggregatedServingEngine", "ServingSpec",
+    "Request", "Slot",
     "ContinuousBatchingScheduler", "build_decode_model", "adopt_params",
-    "BlockManager", "CopyPlan", "PagedStats",
+    "BlockManager", "CopyPlan", "PagedStats", "RadixPrefixCache",
 ]

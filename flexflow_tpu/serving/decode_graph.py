@@ -48,6 +48,15 @@ class ServingSpec:
     # from the per-chip HBM budget, capped at contiguous capacity parity
     kv_num_blocks: int = 0
     prefix_sharing: bool = True  # COW prompt-prefix reuse (paged only)
+    # cross-request radix prefix cache (--serve-prefix-cache): cached
+    # prompt blocks survive their residents under LRU eviction; None
+    # defers to config.serve_prefix_cache. False = live sharing only.
+    prefix_cache: Optional[bool] = None
+    # disaggregated serving: which side this decode compile serves
+    # ("" unified | "prefill" | "decode") — joins the warm-start plan
+    # fingerprint via config.serve_role so the two sides' plans cache
+    # independently
+    role: str = ""
     # extra FFConfig fields applied to the decode compile only (e.g.
     # {"search_budget": 6, "enable_parameter_parallel": True})
     config_overrides: dict = field(default_factory=dict)
@@ -69,6 +78,10 @@ def _decode_config(model, spec: ServingSpec):
     # contiguous and a paged plan can never share a cache address even
     # before the structural graph difference discriminates them
     cfg.serve_kv_layout = spec.kv_layout
+    # the disaggregated role is part of the plan's identity too: the
+    # prefill and decode sides search the same graph over different
+    # sub-meshes and must never share a warm-start address
+    cfg.serve_role = spec.role
     cfg.telemetry_dir = ""
     cfg.xprof_dir = ""
     cfg.diagnostics = False

@@ -84,8 +84,12 @@ class ServingEngine:
             setattr(spec, k, v)
         if spec.prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
+        if spec.prefix_cache is None:
+            spec.prefix_cache = bool(
+                getattr(cfg, "serve_prefix_cache", 1))
         self.model = model
         self.spec = spec
+        self.role = spec.role
         self.telemetry = model._telemetry
         with self._active():
             t0 = time.perf_counter()
@@ -116,6 +120,7 @@ class ServingEngine:
         # pool_blocks ran inside build_decode_model)
         self.block_manager = None
         self._copy_fn = None
+        self._inject_fn = None  # lazily built KV-handoff landing pad
         if spec.kv_layout == "paged":
             from ..fftype import OperatorType as OT
 
@@ -125,7 +130,8 @@ class ServingEngine:
             p = attn.params
             self.block_manager = BlockManager(
                 p.num_blocks, p.block_size, p.blocks_per_slot,
-                sharing=spec.prefix_sharing)
+                sharing=spec.prefix_sharing,
+                cross_time=bool(spec.prefix_cache))
             self._copy_fn = (
                 self.decode_model.executor.build_block_copy())
         # graph input roles: exactly one token stream + the positions /
@@ -154,6 +160,13 @@ class ServingEngine:
 
             self._numerics_reported = {
                 (e["op"], e["phase"]) for e in get_monitor().snapshot()}
+        # disaggregation hooks (serving/disagg.py): the coordinator taps
+        # completions BEFORE block release (to lift the prompt KV out of
+        # the pool while the page table still maps it) and silences the
+        # prefill side's request-grain completion accounting so the
+        # merged metrics plane counts every request exactly once
+        self._pre_release_hook = None
+        self._suppress_completion_events = False
         # run accounting (stats())
         self._decode_iterations = 0
         self._decode_tokens = 0
@@ -181,6 +194,21 @@ class ServingEngine:
         self._g_blocks_used = reg.gauge("serve_kv_blocks_used")
         self._g_blocks_reserved = reg.gauge("serve_kv_blocks_reserved")
         self._c_cow_copies = reg.counter("serve_cow_copies_total")
+        # radix prefix-cache plane (all pre-created — steady-state steps
+        # allocate no metric objects): cached = blocks only the cache
+        # holds (the evictable set), pinned = cache entries a live slot
+        # also maps; hits/misses count admissions, evictions count nodes
+        self._g_prefix_cached = reg.gauge(
+            "serve_prefix_cache_blocks", state="cached")
+        self._g_prefix_pinned = reg.gauge(
+            "serve_prefix_cache_blocks", state="pinned")
+        self._c_prefix_hits = reg.counter("serve_prefix_cache_hits_total")
+        self._c_prefix_misses = reg.counter(
+            "serve_prefix_cache_misses_total")
+        self._c_prefix_evictions = reg.counter(
+            "serve_prefix_cache_evictions_total")
+        self._h_matched_prefix = reg.histogram("serve_matched_prefix_len")
+        self._evictions_seen = 0
         self._c_tokens_out = reg.counter("serve_tokens_generated_total")
         self._c_prefill_tok = reg.counter("serve_prefill_tokens_total")
         self._c_completed = {
@@ -296,6 +324,7 @@ class ServingEngine:
             self._step_fn = new_dec.executor.build_decode_step()
             if self.block_manager is not None:
                 self._copy_fn = new_dec.executor.build_block_copy()
+            self._inject_fn = None  # rebuilt lazily on the new executor
             self.num_chips = int(new_dec.mesh.devices.size)
             trans = new_dec._transition or {}
             decision.update({
@@ -471,8 +500,25 @@ class ServingEngine:
         self._apply_copies(copies)
 
     def _note_completion(self, slot, req: Request):
+        hook = self._pre_release_hook
+        if hook is not None:
+            hook(slot, req)
         if self.block_manager is not None:
             self.block_manager.release(slot.index)
+        if self._suppress_completion_events:
+            # disagg prefill side: the request is not DONE, it is handed
+            # off — the decode side (or the coordinator, for requests
+            # that truly finish at prefill) records the completion once
+            return
+        self.record_completion(req)
+
+    def record_completion(self, req: Request):
+        """Request-grain completion accounting: latency histogram,
+        reason counter, and the `serve.request` event the doctor's
+        drained-TTFT identity counts. Split out of `_note_completion` so
+        the disaggregated coordinator can record a request that finished
+        at prefill (EOS on the first token) on the decode side, which
+        owns completion accounting for the pair."""
         if req.e2e_s is not None:
             self._h_e2e.observe(req.e2e_s)
         c = self._c_completed.get(req.finish_reason)
@@ -489,8 +535,114 @@ class ServingEngine:
             finish_reason=req.finish_reason,
             ttft_s=req.ttft_s,
             queue_wait_s=req.queue_wait_s,
+            matched_prefix_len=req.matched_prefix_len,
             total_s=(req.finish_t - req.submit_t
                      if req.finish_t is not None else None))
+
+    # ------------------------------------------------------------ disagg
+
+    def kv_pool_layers(self) -> list[str]:
+        """Pool-bearing state node names in SORTED order — the layer
+        axis of extract_kv / inject rows. Both handoff sides sort, so
+        layer i's extracted rows land in layer i's pool."""
+        return sorted(n for n, ws in self.decode_model._state.items()
+                      if "pool_k" in ws)
+
+    def extract_kv(self, slot_index: int, num_tokens: int):
+        """Lift a slot's prompt-extent KV blocks off this engine's
+        pools: (layers, blocks, block_size, embed) K and V row stacks.
+        The disaggregated coordinator calls this from its pre-release
+        hook — the completing slot's page table still maps the blocks."""
+        import jax
+
+        mgr = self.block_manager
+        nblk = -(-num_tokens // mgr.block_size)
+        idx = np.asarray(mgr.table(slot_index)[:nblk], np.int32)
+        st = self.decode_model._state
+        ks = [st[name]["pool_k"][idx] for name in self.kv_pool_layers()]
+        vs = [st[name]["pool_v"][idx] for name in self.kv_pool_layers()]
+        ks, vs = jax.device_get((ks, vs))
+        return (np.stack([np.asarray(k) for k in ks]),
+                np.stack([np.asarray(v) for v in vs]))
+
+    def admit_prefilled(self, req: Request, first_token: int,
+                        rows_k, rows_v) -> Optional[int]:
+        """Decode-side admission of a request whose prompt KV was
+        computed on the prefill pool: reserve the worst case, take a
+        free slot with every prompt row accounted for, map any
+        radix-cached prefix (the cross-pool hit path — a cached extent
+        costs NO injection), COW/allocate the uncovered extent, inject
+        the handed-off rows, and publish the prompt into this side's
+        cache. Returns the number of blocks injected (0 = full prefix
+        hit), or None when no slot or reservation is available — the
+        coordinator retries next iteration, FCFS order preserved."""
+        sched = self.scheduler
+        mgr = self.block_manager
+        if mgr is None:
+            raise ValueError(
+                "disaggregated admission requires the paged KV layout")
+        if not sched.free_slots:
+            return None
+        if not mgr.reserve(req.request_id, len(req.prompt),
+                           req.max_new_tokens):
+            return None
+        slot = sched.admit_prefilled(req, first_token)
+        L = len(req.prompt)
+        injected = 0
+        with self._active():
+            telemetry.instant("serve.admitted", trace=req.trace_id,
+                              slot=slot.index, prefilled=True,
+                              queue_wait_s=req.queue_wait_s)
+            mgr.bind_reservation(req.request_id, slot.index)
+            matched = mgr.match_prefix(req.prompt)
+            skip = mgr.admit(slot.index, req.prompt)
+            req.matched_prefix_len = matched
+            self._h_matched_prefix.observe(matched)
+            (self._c_prefix_hits if skip else self._c_prefix_misses).inc()
+            if skip:
+                telemetry.instant(
+                    "serve.prefix_hit", slot=slot.index,
+                    shared_tokens=skip, matched_prefix_len=matched,
+                    prompt_tokens=L)
+            bs = mgr.block_size
+            nlb = -(-L // bs)
+            if matched < L:
+                # the partially-matched tail block (if any) COWs here,
+                # so the injection below never writes a cached block
+                self._apply_copies(
+                    mgr.ensure_writable(slot.index, range(matched, L)))
+                lb0 = matched // bs
+                blocks = mgr.table(slot.index)[lb0:nlb]
+                self._inject_rows(blocks, rows_k[:, lb0:nlb],
+                                  rows_v[:, lb0:nlb])
+                injected = nlb - lb0
+            mgr.register_prompt(slot.index, req.prompt)
+        return injected
+
+    def _inject_rows(self, blocks, rows_k, rows_v):
+        """One donated inject dispatch, padded to a power-of-two block
+        count with (scratch, zero-rows) pairs — one cached executable
+        per bucket, like the COW copies."""
+        import jax.numpy as jnp
+
+        if self._inject_fn is None:
+            self._inject_fn = (
+                self.decode_model.executor.build_kv_inject())
+        b = 1
+        while b < len(blocks):
+            b *= 2
+        idx = np.full((b,), SCRATCH_BLOCK, np.int32)
+        idx[:len(blocks)] = blocks
+        layers = rows_k.shape[0]
+        pk = np.zeros((layers, b) + rows_k.shape[2:], rows_k.dtype)
+        pv = np.zeros((layers, b) + rows_v.shape[2:], rows_v.dtype)
+        pk[:, :len(blocks)] = rows_k
+        pv[:, :len(blocks)] = rows_v
+        dec = self.decode_model
+        with telemetry.span("serve.kv_inject", blocks=len(blocks)):
+            dec._state = self._inject_fn(
+                dec._state, jnp.asarray(idx), jnp.asarray(pk),
+                jnp.asarray(pv))
 
     # ------------------------------------------------------------ iterate
 
@@ -525,6 +677,13 @@ class ServingEngine:
                 self._g_blocks_free.set(mgr.free_blocks)
                 self._g_blocks_used.set(mgr.blocks_in_use)
                 self._g_blocks_reserved.set(mgr.reserved_total)
+                cached_only = mgr.cached_only_blocks
+                self._g_prefix_cached.set(cached_only)
+                self._g_prefix_pinned.set(mgr.cached_blocks - cached_only)
+                ev = mgr.stats.radix_evictions
+                if ev > self._evictions_seen:
+                    self._c_prefix_evictions.inc(ev - self._evictions_seen)
+                    self._evictions_seen = ev
             telemetry.counter("serve.slots", {
                 "active": len(prefilling) + len(decoding),
                 "queue": sched.queue_depth,
@@ -545,12 +704,18 @@ class ServingEngine:
                     # requests still shares — the first resident computed
                     # and registered its blocks by the time the next one
                     # prefills (one chunk per iteration, FCFS)
+                    matched = mgr.match_prefix(pre.request.prompt)
                     skip = mgr.admit(pre.index, pre.request.prompt)
                     pre.prefill_pos = skip
+                    pre.request.matched_prefix_len = matched
+                    self._h_matched_prefix.observe(matched)
+                    (self._c_prefix_hits if skip
+                     else self._c_prefix_misses).inc()
                     if skip:
                         telemetry.instant(
                             "serve.prefix_hit", slot=pre.index,
                             shared_tokens=skip,
+                            matched_prefix_len=matched,
                             prompt_tokens=len(pre.request.prompt))
                 L = len(pre.request.prompt)
                 start, n = plan_chunks(
@@ -761,6 +926,8 @@ class ServingEngine:
             # still dominate what is resident when it opens
             fresh.blocks_in_use_peak = self.block_manager.blocks_in_use
             self.block_manager.stats = fresh
+            # the eviction-delta poll restarts from the fresh counter
+            self._evictions_seen = 0
 
     def stats(self) -> dict:
         """Aggregate run metrics; rates are per chip of the decode mesh
@@ -807,6 +974,15 @@ class ServingEngine:
                 "prefix_hit_rate": mgr.stats.prefix_hit_rate,
                 "prefix_shared_tokens": mgr.stats.shared_tokens,
                 "cow_copies": mgr.stats.cow_copies,
+                # radix prefix-cache plane: cross-time hits are the
+                # prefixes that survived their residents (the cache's
+                # whole reason to exist); evictions price the budget
+                "prefix_cache": bool(self.spec.prefix_cache),
+                "cross_time_hits": mgr.stats.cross_time_hits,
+                "radix_evictions": mgr.stats.radix_evictions,
+                "radix_evicted_blocks": mgr.stats.radix_evicted_blocks,
+                "prefix_cached_blocks": mgr.cached_blocks,
+                "prefix_cached_only_blocks": mgr.cached_only_blocks,
                 # slots-at-fixed-HBM headline: how many contiguous
                 # max_seq slots the pool's PEAK working set would buy —
                 # the vLLM capacity-recovery metric

@@ -10,50 +10,47 @@ block. Three consequences this module implements:
 - **allocation at block granularity** — a slot holds ceil(length/bs)
   blocks, not max_seq rows, so short generations stop paying long-context
   HBM and the pool (not slots × max_seq) bounds concurrency;
-- **prefix sharing with refcounts** — prompt blocks are registered under
-  the FULL token prefix they encode (K/V of a row depends on every token
-  before it, so the key is the whole prefix, not the block's own tokens);
-  a new request whose prompt extends a registered prefix maps the shared
-  blocks into its own table (refcount++) and skips recomputing them —
-  N requests with one system prompt store and prefill it once;
+- **prefix sharing via a radix tree** (radix.RadixPrefixCache) — prompt
+  blocks are published into a token-labelled radix tree at prefill
+  completion, keyed on the PROMPT extent only (K/V of a row depends on
+  every token before it, so tree position is the content address); a new
+  request maps the longest cached extent — including a partial match
+  inside one block — into its own table (refcount++) and skips
+  recomputing it. Each cached node holds one refcount on its block (the
+  CACHE PIN), so prefixes SURVIVE their residents: sharing is
+  cross-time, not just among live slots;
 - **copy-on-write** — a write (decode append, or a prompt tail diverging
-  inside a shared partial block) targeting a block with refcount > 1
+  inside a shared block) targeting a block with more than one reference
   first copies it to a fresh block (`CopyPlan` — the engine runs the
-  device-side block copy), so divergence is paid only at the first
-  divergent write and only for the one block it lands in.
+  device-side block copy). The pin makes every cached block
+  COW-protected: a decode extending past its prompt can never overwrite
+  cached prompt content (the poisoning the old full-prefix registry
+  allowed), it pays one copy and owns the fresh block.
 
 Physical block 0 is the RESERVED SCRATCH BLOCK (never allocated, never
 freed): unallocated page-table entries point at it, and the device op
 routes position-clipped writes there — the paged equivalent of the
 contiguous layout's scratch row.
 
-Sharing is among LIVE residents: releasing a slot decrements its blocks'
-refcounts and a block returning to refcount 0 is freed and unregistered
-(refcount-exact reclamation — tested). There is no cross-time cache; the
-continuous batch's overlap is what the shared-prefix bench measures.
+Pool pressure: admission reserves each request's worst case against the
+FREE list (Σ reservations <= free blocks at all times, so a decode write
+can NEVER exhaust the pool mid-flight); when the free list is too small,
+`reserve` first EVICTS cold cache leaves LRU-first (radix.evict_lru) —
+an evicted node only frees its block when the pin was the last
+reference; a block a live slot still maps merely leaves the cache.
+`cross_time=False` reproduces the old live-residents-only sharing (the
+pin is dropped as the last holder releases) — the bench ablation.
 
 Pure host code (no jax): unit-testable without a mesh.
 """
 
 from __future__ import annotations
 
-import hashlib
 from dataclasses import dataclass
 
+from .radix import RadixPrefixCache
+
 SCRATCH_BLOCK = 0
-
-
-def _chain(digest: bytes, tokens) -> bytes:
-    """One prefix-hash chaining step: digest of (parent digest, the next
-    run of tokens). K/V rows depend on the ENTIRE prefix before them, so
-    a block's content address must encode every earlier token — chaining
-    from the parent block's digest does that in O(block) per block
-    (vLLM's hash-based prefix caching scheme) instead of hashing the
-    whole O(L) prefix tuple per block."""
-    h = hashlib.sha256(digest)
-    for t in tokens:
-        h.update(int(t).to_bytes(8, "little", signed=True))
-    return h.digest()
 
 
 @dataclass
@@ -68,11 +65,16 @@ class CopyPlan:
 @dataclass
 class PagedStats:
     prefix_queries: int = 0        # admissions that attempted a match
-    prefix_hits: int = 0           # admissions that shared >= 1 block
+    prefix_hits: int = 0           # admissions that shared >= 1 token
     shared_tokens: int = 0         # prompt tokens served from shared blocks
     prompt_tokens: int = 0         # total prompt tokens admitted
     cow_copies: int = 0
-    blocks_in_use_peak: int = 0
+    blocks_in_use_peak: int = 0    # peak LIVE blocks (cache-only excluded)
+    cross_time_hits: int = 0       # hits where a matched block had no
+    #                                live holder — served from the cache
+    #                                after its residents exited
+    radix_evictions: int = 0       # nodes evicted (LRU or pin-drop)
+    radix_evicted_blocks: int = 0  # blocks actually freed by eviction
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -84,10 +86,16 @@ class PagedStats:
 
 
 class BlockManager:
-    """Refcounted block pool + per-slot page tables + prefix registry."""
+    """Refcounted block pool + per-slot page tables + radix prefix cache.
+
+    `refcount(blk)` reports LIVE holders (slots mapping the block); the
+    cache pin is internal bookkeeping and excluded. `blocks_in_use`
+    likewise counts live blocks only — a drained pool reads 0 even while
+    the cache retains (evictable) blocks.
+    """
 
     def __init__(self, num_blocks: int, block_size: int, table_width: int,
-                 sharing: bool = True):
+                 sharing: bool = True, cross_time: bool = False):
         if num_blocks < 2:
             raise ValueError(
                 f"need >= 2 blocks (scratch + 1 allocatable), got "
@@ -98,9 +106,12 @@ class BlockManager:
         self.block_size = int(block_size)
         self.table_width = int(table_width)
         self.sharing = bool(sharing)  # False = paged-without-reuse ablation
+        self.cross_time = bool(cross_time)  # False = live sharing only
         # LIFO free list: hot blocks are reused while still cached
         self._free = list(range(num_blocks - 1, 0, -1))
+        # RAW references: live slot mappings + (if cached) one cache pin
         self._refcount: dict[int, int] = {}
+        self._live = 0  # blocks with >= 1 live (non-pin) reference
         # admission reservations (worst-case fresh blocks per resident),
         # keyed by request id until bind_reservation moves the key to the
         # slot index: Σ reservations <= free blocks at all times, so a
@@ -109,11 +120,7 @@ class BlockManager:
         self._reserved: dict = {}
         # slot index -> logical->physical list (allocated prefix only)
         self._tables: dict[int, list[int]] = {}
-        # prefix registry: chained digest of prompt[:end] (see _chain) ->
-        # physical block holding rows [end - fill, end); a partial tail's
-        # digest covers its exact extent
-        self._registry: dict[bytes, int] = {}
-        self._block_key: dict[int, bytes] = {}  # reverse map for unregister
+        self.cache = RadixPrefixCache(block_size) if self.sharing else None
         self.stats = PagedStats()
 
     # ------------------------------------------------------------ queries
@@ -124,7 +131,22 @@ class BlockManager:
 
     @property
     def blocks_in_use(self) -> int:
-        return self.num_blocks - 1 - len(self._free)
+        """Blocks held by at least one live slot (cache-only excluded)."""
+        return self._live
+
+    @property
+    def cached_blocks(self) -> int:
+        """Blocks the radix cache holds a pin on (live-shared or not)."""
+        return 0 if self.cache is None else len(self.cache.pinned)
+
+    @property
+    def cached_only_blocks(self) -> int:
+        """Cached blocks whose pin is the sole reference — the evictable
+        set the admission gate can reclaim."""
+        if self.cache is None:
+            return 0
+        return sum(1 for b in self.cache.pinned
+                   if self._refcount.get(b, 0) == 1)
 
     def table(self, slot: int) -> list[int]:
         """The slot's page table padded to table_width with SCRATCH (the
@@ -132,8 +154,13 @@ class BlockManager:
         t = self._tables.get(slot, [])
         return t + [SCRATCH_BLOCK] * (self.table_width - len(t))
 
+    def _pinned(self, block: int) -> bool:
+        return self.cache is not None and block in self.cache.pinned
+
     def refcount(self, block: int) -> int:
-        return self._refcount.get(block, 0)
+        """LIVE holders of `block` (the cache pin is excluded)."""
+        rc = self._refcount.get(block, 0)
+        return rc - 1 if rc and self._pinned(block) else rc
 
     def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
         """Worst-case fresh blocks a request can consume over its life:
@@ -155,10 +182,15 @@ class BlockManager:
     def reserve(self, request_id, prompt_len: int,
                 max_new_tokens: int) -> bool:
         """Admission gate: reserve the request's worst case against the
-        pool. False = not enough headroom (the caller keeps the request
-        queued — FCFS head-blocking, so admission order never depends on
-        pool pressure in a way that could reorder token streams)."""
+        pool, evicting cold cache leaves first when the free list alone
+        cannot cover it. False = not enough headroom even after eviction
+        (the caller keeps the request queued — FCFS head-blocking, so
+        admission order never depends on pool pressure in a way that
+        could reorder token streams)."""
         needed = self.blocks_needed(prompt_len, max_new_tokens)
+        headroom = self.free_blocks - self.reserved_total
+        if headroom < needed:
+            self._evict_blocks(needed - headroom)
         if self.free_blocks - self.reserved_total < needed:
             return False
         self._reserved[("req", request_id)] = needed
@@ -171,67 +203,92 @@ class BlockManager:
         if n is not None:
             self._reserved[slot] = n
 
+    # --------------------------------------------------------- refcounts
+
+    def _map(self, block: int):
+        """One more live holder of `block`."""
+        if self.refcount(block) == 0:
+            self._live += 1
+        self._refcount[block] = self._refcount.get(block, 0) + 1
+
+    def _unmap(self, block: int):
+        """One live holder of `block` gone; frees at zero references."""
+        if self.refcount(block) == 1:
+            self._live -= 1
+        n = self._refcount[block] - 1
+        if n == 0:
+            del self._refcount[block]
+            self._free.append(block)
+        else:
+            self._refcount[block] = n
+
+    def _unpin_free(self, block: int):
+        """Drop the cache pin's reference (the node is already out of the
+        cache); frees at zero."""
+        n = self._refcount[block] - 1
+        if n == 0:
+            del self._refcount[block]
+            self._free.append(block)
+        else:
+            self._refcount[block] = n
+
+    def _evict_blocks(self, need: int) -> int:
+        """Evict LRU cache leaves until `need` blocks are freed (or the
+        cache runs out of freeable nodes). A victim whose block a live
+        slot still maps frees nothing — it only leaves the cache (and
+        unblocks a freeable ancestor)."""
+        if self.cache is None or need <= 0:
+            return 0
+        freed = 0
+        while freed < need:
+            before = len(self._free)
+            blk = self.cache.evict_lru(
+                lambda b: self._refcount.get(b, 0) == 1)
+            if blk is None:
+                break
+            self._unpin_free(blk)
+            self.stats.radix_evictions += 1
+            if len(self._free) > before:
+                freed += 1
+                self.stats.radix_evicted_blocks += 1
+        return freed
+
     # ------------------------------------------------------------ intake
 
-    def _match(self, prompt: list[int]):
-        """(covered, [block digests]): the longest registered prefix of
-        `prompt` at block granularity — full blocks at every block_size
-        boundary (digest chained per block), then the longest registered
-        PARTIAL extent inside the next block (its digest covers the exact
-        extent — a prompt of 6 registered tokens serves both its twin and
-        a longer prompt extending it, the latter COWing on its first tail
-        write). Digests are returned so admit() maps without rehashing."""
-        bs = self.block_size
-        L = len(prompt)
-        covered = 0
-        keys: list[bytes] = []
-        if not self.sharing:
-            return 0, keys
-        digest = b""
-        for end in range(bs, L + 1, bs):
-            nxt = _chain(digest, prompt[end - bs:end])
-            if nxt not in self._registry:
-                break
-            digest = nxt
-            keys.append(nxt)
-            covered = end
-        best = None
-        for end in range(covered + 1, min(covered + bs - 1, L) + 1):
-            part = _chain(digest, prompt[covered:end])
-            if part in self._registry:
-                best = (end, part)
-        if best is not None:
-            covered = best[0]
-            keys.append(best[1])
-        return covered, keys
-
-    def match_prefix(self, prompt: list[int]) -> int:
-        """Covered token count of the longest registered prefix (see
-        `_match`)."""
-        return self._match(prompt)[0]
+    def match_prefix(self, prompt) -> int:
+        """Covered token count of the longest cached extent of `prompt`
+        (a pure peek: no stats, no LRU touch)."""
+        if self.cache is None:
+            return 0
+        return self.cache.match(prompt, peek=True)[0]
 
     def admit(self, slot: int, prompt: list[int]) -> int:
-        """Build `slot`'s page table: map every shared prefix block
-        (refcount++), leave the rest for prefill writes to allocate.
-        Called LAZILY — at the slot's first prefill chunk, not at
-        admission — so a burst of same-prefix requests still shares: by
-        the time the second request prefills, the first has computed and
-        registered its blocks. Returns the prefill cursor: prompt tokens
-        whose K/V need no recomputation, capped at len(prompt) - 1
+        """Build `slot`'s page table: map every block of the longest
+        cached extent (refcount++), leave the rest for prefill writes to
+        allocate. Called LAZILY — at the slot's first prefill chunk, not
+        at admission — so a burst of same-prefix requests still shares:
+        by the time the second request prefills, the first has computed
+        and registered its blocks. Returns the prefill cursor: prompt
+        tokens whose K/V need no recomputation, capped at len(prompt) - 1
         because the final token's logits row samples the first generated
         token (its re-write into a fully-shared block is the first
         COW)."""
         if slot in self._tables:
             raise ValueError(f"slot {slot} already holds a table")
         L = len(prompt)
-        covered, keys = self._match(prompt)
         self.stats.prefix_queries += 1
+        if self.cache is not None:
+            covered, blocks = self.cache.match(prompt)
+        else:
+            covered, blocks = 0, []
+        # a matched block with no live holder was served across time —
+        # its residents exited and only the cache pin kept it
+        cross = any(self._refcount.get(b, 0) == 1 for b in blocks)
         table: list[int] = []
-        for key in keys:
-            # full blocks, plus the shared partial tail (mapped
-            # read-only; the first write into it COWs)
-            blk = self._registry[key]
-            self._refcount[blk] += 1
+        for blk in blocks:
+            # full blocks, plus a partially-matched tail (mapped
+            # read-only; the first write into it COWs under the pin)
+            self._map(blk)
             table.append(blk)
         self._tables[slot] = table
         skip = min(covered, L - 1)
@@ -239,30 +296,42 @@ class BlockManager:
         self.stats.shared_tokens += skip
         if skip:
             self.stats.prefix_hits += 1
+            if cross:
+                self.stats.cross_time_hits += 1
+        self._note_peak()
         return skip
 
     # ------------------------------------------------------------ writes
 
+    def _note_peak(self):
+        if self._live > self.stats.blocks_in_use_peak:
+            self.stats.blocks_in_use_peak = self._live
+
     def _alloc(self, slot: int) -> int:
+        if not self._free:
+            # the admission reservations make this unreachable; evict
+            # rather than die if an embedder drives the manager directly
+            self._evict_blocks(1)
         if not self._free:
             raise RuntimeError(
                 "paged KV pool exhausted — the admission reservations "
                 "(reserve/blocks_needed) must prevent this")
         blk = self._free.pop()
         self._refcount[blk] = 1
+        self._live += 1
         if slot in self._reserved:
             self._reserved[slot] = max(0, self._reserved[slot] - 1)
-        self.stats.blocks_in_use_peak = max(
-            self.stats.blocks_in_use_peak, self.blocks_in_use)
+        self._note_peak()
         return blk
 
     def ensure_writable(self, slot: int, positions) -> list[CopyPlan]:
         """Guarantee every logical block covering `positions` is owned
-        (refcount 1) by `slot`, allocating fresh blocks past the table end
-        and COW-copying shared ones. Returns the copies the engine must
-        apply to the device pool BEFORE the step that writes. Also
-        unregisters any owned block about to be written (its content — and
-        therefore its prefix key — is changing)."""
+        solely (one live reference, no pin) by `slot`, allocating fresh
+        blocks past the table end and COW-copying referenced ones.
+        Returns the copies the engine must apply to the device pool
+        BEFORE the step that writes. A CACHED block always COWs (the pin
+        keeps its raw count above one), so published prompt content is
+        immutable — decode extension can never poison the cache."""
         table = self._tables.get(slot)
         if table is None:
             raise ValueError(f"slot {slot} has no table")
@@ -278,72 +347,80 @@ class BlockManager:
             blk = table[lb]
             if self._refcount.get(blk, 0) > 1:
                 fresh = self._alloc(slot)
-                self._refcount[blk] -= 1
+                self._unmap(blk)
                 table[lb] = fresh
                 copies.append(CopyPlan(src=blk, dst=fresh))
                 self.stats.cow_copies += 1
-            elif blk in self._block_key:
-                # sole owner writing into a registered block: future
-                # prompts must not match stale content
-                self._registry.pop(self._block_key.pop(blk), None)
+                self._maybe_drop_cached(blk)
         return copies
 
     def register_prompt(self, slot: int, prompt: list[int]):
-        """Publish `slot`'s prompt blocks for prefix sharing (called once
-        when its prefill completes): every full block under the full-
-        prefix key, plus the partial tail. Blocks already registered (the
-        shared source) keep their entry."""
-        if not self.sharing:
+        """Publish `slot`'s prompt blocks into the radix cache (called
+        once when its prefill completes), keyed on the PROMPT extent only
+        — decode-written rows are never published (any later write into a
+        published block COWs away from it). Exact-run incumbents keep
+        their entry; newly inserted nodes pin their blocks."""
+        if self.cache is None:
             return
         table = self._tables.get(slot, [])
-        bs = self.block_size
-        L = len(prompt)
-        digest = b""
-        for lb in range(len(table)):
-            end = min((lb + 1) * bs, L)
-            if end <= lb * bs:
-                break
-            key = _chain(digest, prompt[lb * bs:end])
-            if end == (lb + 1) * bs:
-                digest = key  # full block: the next block chains from it
-            if key not in self._registry:
-                blk = table[lb]
-                if blk in self._block_key:
-                    continue  # already published under another key
-                self._registry[key] = blk
-                self._block_key[blk] = key
+        for blk in self.cache.insert(prompt, table):
+            self._refcount[blk] = self._refcount.get(blk, 0) + 1
 
     # ------------------------------------------------------------ release
 
     def release(self, slot: int):
         """Drop the slot's table; refcounts decrement and blocks reaching
-        zero return to the free list (and leave the prefix registry)."""
+        zero references return to the free list. With `cross_time` the
+        cache keeps its pinned blocks (that is the point — the prefix
+        outlives the resident); without it, a block left holding only its
+        pin is dropped from the cache and freed immediately (the old
+        live-residents-only semantics)."""
         self._reserved.pop(slot, None)
         table = self._tables.pop(slot, None)
         if table is None:
             return
         for blk in table:
-            n = self._refcount.get(blk, 0) - 1
-            if n > 0:
-                self._refcount[blk] = n
-                continue
-            self._refcount.pop(blk, None)
-            self._registry.pop(self._block_key.pop(blk, None), None)
-            self._free.append(blk)
+            self._unmap(blk)
+            self._maybe_drop_cached(blk)
+
+    def _maybe_drop_cached(self, block: int):
+        """Without `cross_time`, a block left holding only its cache pin
+        is dropped and freed on the spot — the old live-residents-only
+        sharing semantics (a prefix dies with its last holder)."""
+        if (not self.cross_time and self.cache is not None
+                and self._refcount.get(block, 0) == 1
+                and block in self.cache.pinned):
+            self.cache.drop_block(block)
+            self.stats.radix_evictions += 1
+            self.stats.radix_evicted_blocks += 1
+            self._unpin_free(block)
 
     def check_invariants(self):
-        """Debug/test hook: every block is free xor refcounted, the
-        scratch block is neither, and table entries are refcounted."""
+        """Debug/test hook: every block is free xor referenced, the
+        scratch block is neither, table entries have a live reference,
+        the live-block counter reproduces from the raw counts, and the
+        radix tree agrees with the pin accounting."""
         free = set(self._free)
         assert SCRATCH_BLOCK not in free
         assert SCRATCH_BLOCK not in self._refcount
         assert not (free & set(self._refcount)), "block both free and live"
         for slot, table in self._tables.items():
             for blk in table:
-                assert self._refcount.get(blk, 0) >= 1, \
-                    f"slot {slot} maps unrefcounted block {blk}"
+                assert self.refcount(blk) >= 1, \
+                    f"slot {slot} maps block {blk} with no live reference"
         counted = sum(1 for _ in self._refcount)
         assert counted + len(free) == self.num_blocks - 1, \
             "pool accounting leak"
+        live = sum(1 for b in self._refcount if self.refcount(b) > 0)
+        assert live == self._live, \
+            f"live counter drifted: cached {self._live}, actual {live}"
         assert self.reserved_total <= self.free_blocks, \
             "reservations exceed the free pool"
+        if self.cache is not None:
+            self.cache.check_invariants()
+            for blk in self.cache.pinned:
+                assert self._refcount.get(blk, 0) >= 1, \
+                    f"cache pins unreferenced block {blk}"
+            if not self.cross_time:
+                assert self.cached_only_blocks == 0, \
+                    "cross_time off but cache retains resident-free blocks"

@@ -40,6 +40,9 @@ class Request:
     first_token_t: Optional[float] = None  # TTFT anchor
     last_token_t: Optional[float] = None  # previous token (TBT anchor)
     finish_t: Optional[float] = None
+    # longest cached prefix extent the radix cache matched at admission
+    # (block-granular; 0 on a cold miss, None before admission)
+    matched_prefix_len: Optional[int] = None
 
     @property
     def trace_id(self) -> str:
@@ -174,6 +177,28 @@ class ContinuousBatchingScheduler:
             slot.admit_seq = self._admit_counter
             out.append((slot, req))
         return out
+
+    def admit_prefilled(self, request: Request,
+                        first_token: int) -> Optional[Slot]:
+        """Admit a request whose prompt KV was computed ELSEWHERE (the
+        disaggregated prefill pool) straight into decode: the slot starts
+        with every prompt row accounted for (`length = len(prompt)`) and
+        the prefill-sampled first token as the next decode input —
+        `prefill_pos` stays None so the engine never re-prefills. Returns
+        None when no slot is free (the coordinator retries next step)."""
+        free = self.free_slots
+        if not free:
+            return None
+        slot = free[0]
+        slot.request = request
+        slot.length = len(request.prompt)
+        slot.last_token = int(first_token)
+        slot.prefill_pos = None
+        if request.admit_t is None:
+            request.admit_t = time.perf_counter()
+        self._admit_counter += 1
+        slot.admit_seq = self._admit_counter
+        return slot
 
     # ------------------------------------------------------------ completion
 

@@ -42,6 +42,11 @@ __all__ = [
 ]
 
 _active: Optional[TelemetrySession] = None
+# same-session nesting depth: the disaggregated serving coordinator
+# holds one activation across an overlapped step while both engines'
+# inner _active() blocks enter and exit on their own threads — an
+# unbalanced deactivate must not tear the session down mid-step
+_depth: int = 0
 
 
 class _NoopSpan:
@@ -61,17 +66,31 @@ _NOOP = _NoopSpan()
 
 
 def activate(session: TelemetrySession) -> TelemetrySession:
-    """Install `session` as the process-wide telemetry sink."""
-    global _active
-    _active = session
+    """Install `session` as the process-wide telemetry sink. Activating
+    the session that is already active nests: the sink stays installed
+    until the matching number of deactivate(session) calls."""
+    global _active, _depth
+    if _active is session:
+        _depth += 1
+    else:
+        _active = session
+        _depth = 1
     return session
 
 
 def deactivate(session: Optional[TelemetrySession] = None):
-    """Remove the active session (or only `session`, if it is active)."""
-    global _active
-    if session is None or _active is session:
+    """Remove the active session (or only `session`, if it is active).
+    Same-session activations nest — only the outermost deactivate
+    removes the sink; deactivate(None) always tears down."""
+    global _active, _depth
+    if session is None:
         _active = None
+        _depth = 0
+    elif _active is session:
+        _depth -= 1
+        if _depth <= 0:
+            _active = None
+            _depth = 0
 
 
 def active_session() -> Optional[TelemetrySession]:

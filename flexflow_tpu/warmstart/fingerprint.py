@@ -70,6 +70,13 @@ _SEARCH_CONFIG_FIELDS = (
     # tensors differ structurally too, but as with computation_mode the
     # field is the explicit discriminator the round-trip test pins
     "serve_kv_layout",
+    # disaggregated serving (serving/disagg.py): the prefill and decode
+    # sides are two independently searched plans over different
+    # sub-meshes — the role (and the device offset carving the sub-mesh
+    # out of the global device list) must keep their cache addresses
+    # apart even when graph + mesh shape coincide
+    "serve_role",
+    "mesh_device_offset",
 )
 
 

@@ -98,6 +98,55 @@ def main():
                         f"transition verification recorded "
                         f"{ta['errors']} error(s) — the migration ran "
                         f"unverified (--no-verify-plan)")
+            # disagg gate: every KV handoff the serving_disagg section
+            # records must reference a verified transfer program whose
+            # predicted seconds reproduce from its own per-transfer
+            # entries alone (the same makespan identity as the
+            # transition gate), and must agree with that program's
+            # price; a fully radix-cached handoff moved zero rows and
+            # carries no program by construction
+            disagg = rep.get("serving_disagg")
+            if disagg is not None:
+                from flexflow_tpu.analysis.transition import (
+                    verify_transition_total,
+                )
+
+                programs = disagg.get("programs") or {}
+                for key, prog in programs.items():
+                    tt = verify_transition_total(prog)
+                    want = prog.get("predicted_s", 0.0)
+                    if abs(tt - want) > 1e-9 + 1e-6 * abs(want):
+                        problems.append(
+                            f"handoff program {key}: per-transfer costs "
+                            f"({tt}) do not reproduce predicted_s "
+                            f"({want})")
+                    pa = prog.get("analysis") or {}
+                    if pa.get("errors", 0):
+                        problems.append(
+                            f"handoff program {key}: transfer "
+                            f"verification recorded {pa['errors']} "
+                            f"error(s)")
+                for i, h in enumerate(disagg.get("handoffs", [])):
+                    nblk = int(h.get("injected_blocks", 0))
+                    if nblk == 0:
+                        if h.get("predicted_s", 0.0):
+                            problems.append(
+                                f"handoff {i}: fully cached (0 blocks) "
+                                f"but predicted_s is nonzero")
+                        continue
+                    prog = programs.get(str(nblk))
+                    if prog is None:
+                        problems.append(
+                            f"handoff {i}: no verified transfer program "
+                            f"for its {nblk}-block extent")
+                        continue
+                    if abs(h.get("predicted_s", 0.0)
+                           - prog.get("predicted_s", 0.0)) > 1e-9:
+                        problems.append(
+                            f"handoff {i}: predicted_s "
+                            f"({h.get('predicted_s')}) does not match "
+                            f"its program's price "
+                            f"({prog.get('predicted_s')})")
             # ffelastic gate: every priced re-plan decision must be
             # reproducible from the record alone — both sides of the
             # pay-off inequality recompute from their recorded factors,

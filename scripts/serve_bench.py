@@ -8,7 +8,7 @@ with tunable load, for serving-focused profiling:
   python scripts/serve_bench.py [--requests N] [--slots S]
       [--prompt-len P] [--max-new-tokens T] [--shared-prefix K]
       [--arrival-rate R] [--burst B] [--layout paged|contiguous|both]
-      [--telemetry-dir DIR] [flexflow flags]
+      [--disaggregate] [--telemetry-dir DIR] [flexflow flags]
 
 --shared-prefix K (default: prompt-len // 2) prepends one K-token system
 prompt to every request — the N-users-one-system-prompt trace the paged
@@ -23,6 +23,15 @@ windows of 8 arrivals have their inter-arrival gaps divided by B (a
 bursty trace at the same average rate). The report then carries
 TTFT/TBT/queue-wait p50/p95/p99 from the engine's mergeable histograms
 (engine.metrics_summary).
+
+--disaggregate replaces the layout ablation with the DISAGGREGATION
+ablation: the identical trace runs through the unified paged engine and
+through serve(disaggregate=True) (split prefill/decode pools at the same
+total chip count, KV moved per request by verified fftrans handoffs),
+completions asserted bit-identical, and the payload carries both sides'
+TTFT/TBT/queue-wait percentiles plus the handoff measured-vs-predicted
+seconds — the ISSUE 19 acceptance harness for "disagg + radix cache
+improves TTFT p95 at equal chips on the bursty shared-prefix trace".
 
 With --layout both (default) the same trace runs through both KV layouts
 and the report carries, next to each layout's req/s/chip:
@@ -70,6 +79,27 @@ def _pop_float(argv, flag, default):
     return default
 
 
+def _pop_flag(argv, flag):
+    if flag in argv:
+        argv.remove(flag)
+        return True
+    return False
+
+
+def _drained(engine):
+    """Both engine shapes: the disaggregated coordinator exposes its own
+    drained property (covers both schedulers + pending handoffs)."""
+    if hasattr(engine, "prefill_chips"):
+        return engine.drained
+    return engine.scheduler.drained
+
+
+def _completed(engine):
+    if hasattr(engine, "prefill_chips"):
+        return engine.completed
+    return engine.scheduler.completed
+
+
 def open_loop_offsets(n, rate, burst, rs):
     """Seeded bursty-Poisson arrival offsets (seconds from window start):
     exponential inter-arrival gaps at `rate` req/s, with every other
@@ -86,12 +116,14 @@ def open_loop_offsets(n, rate, burst, rs):
 
 
 def run_trace(ff, layout, prompts, slots, max_new, arrival_rate=0.0,
-              burst=1.0, **serve_kw):
+              burst=1.0, disaggregate=False, warm="slots", **serve_kw):
     """Run `prompts` through a fresh engine of `layout`; returns
     (completions, metrics_summary) with the measured window warmed +
     reset. arrival_rate > 0 drives the trace open-loop (submission by
     wall clock on a seeded bursty-Poisson process); otherwise all
-    requests queue up front and the engine drains closed-loop."""
+    requests queue up front and the engine drains closed-loop.
+    disaggregate=True routes through serve(disaggregate=True) — split
+    prefill/decode pools at the same total chip count."""
     import time
 
     import numpy as np
@@ -99,22 +131,32 @@ def run_trace(ff, layout, prompts, slots, max_new, arrival_rate=0.0,
     kw = {"max_new_tokens": max_new, "kv_layout": layout, **serve_kw}
     if slots:
         kw["slots"] = slots
+    if disaggregate:
+        kw["disaggregate"] = True
     engine = ff.serve(**kw)
     # warm the bucket/decode/copy executables so the measured drain is
-    # steady state
-    engine.generate(prompts[:1])
+    # steady state: a full slot-width batch compiles every decode batch
+    # bucket (and, disaggregated, both sides' buckets + the KV-inject
+    # programs) — a 1-request warmup leaves those compiles inside the
+    # measured window, where they read as multi-second TTFT/TBT spikes.
+    # warm="trace" pre-runs the whole trace once instead (the
+    # disaggregation comparison below measures the steady state, where
+    # every radix-hit-shrunk inject extent has already compiled)
+    nwarm = (len(prompts) if warm == "trace"
+             else max(1, min(len(prompts), slots or 1)))
+    engine.generate(prompts[:nwarm])
     engine.reset_stats()
     if arrival_rate > 0:
         offsets = open_loop_offsets(
             len(prompts), arrival_rate, burst, np.random.RandomState(7))
         t0 = time.perf_counter()
         i = 0
-        while i < len(prompts) or not engine.scheduler.drained:
+        while i < len(prompts) or not _drained(engine):
             now = time.perf_counter() - t0
             while i < len(prompts) and offsets[i] <= now:
                 engine.submit(prompts[i])
                 i += 1
-            if engine.scheduler.drained:
+            if _drained(engine):
                 # idle between bursts: sleep to the next arrival instead
                 # of spinning (open loop — the clock, not the engine,
                 # paces submissions)
@@ -127,10 +169,22 @@ def run_trace(ff, layout, prompts, slots, max_new, arrival_rate=0.0,
         for p in prompts:
             engine.submit(p)
         engine.run_until_drained()
-    done = sorted(engine.scheduler.completed,
+    done = sorted(_completed(engine),
                   key=lambda r: r.request_id)  # submission order: the
     # cross-layout parity check must not depend on completion timing
-    return [r.generated for r in done], engine.metrics_summary()
+    stats = engine.metrics_summary()
+    if disaggregate:
+        # lift the per-side request-grain percentiles to the flat keys
+        # the payload loop below reads: TTFT + queue wait observe on the
+        # prefill side, TBT on the decode side
+        for short, side in (("ttft", "prefill"), ("queue_wait", "prefill"),
+                            ("tbt", "decode")):
+            for q in ("p50", "p95", "p99"):
+                key = f"{short}_{q}_s"
+                v = (stats.get(side) or {}).get(key)
+                if v is not None and key not in stats:
+                    stats[key] = v
+    return [r.generated for r in done], stats
 
 
 def main():
@@ -144,13 +198,21 @@ def main():
     arrival_rate = _pop_float(argv, "--arrival-rate", 0.0)
     burst = _pop_float(argv, "--burst", 1.0)
     layout = _pop_str(argv, "--layout", "both")
+    disaggregate = _pop_flag(argv, "--disaggregate")
     sys.argv = [sys.argv[0]] + argv
     if not kv_block_size:
         # block granularity must divide INTO the shared prefix for the
         # sharing to be visible; half the prefix keeps at least one full
-        # shared block plus a partial tail (the COW case)
-        kv_block_size = max(2, min(16, shared_prefix // 2)) \
-            if shared_prefix >= 4 else 0
+        # shared block plus a partial tail (the COW case). Disaggregated
+        # runs pin radix prefixes across time, so they need FINE blocks
+        # and the deep pool they imply — the half-prefix heuristic at
+        # e.g. 21 shared tokens yields 10-token blocks and a ~29-block
+        # pool that thrashes between pinned prefixes and live decodes
+        if disaggregate:
+            kv_block_size = 4 if shared_prefix >= 4 else 0
+        else:
+            kv_block_size = max(2, min(16, shared_prefix // 2)) \
+                if shared_prefix >= 4 else 0
 
     import jax
     import numpy as np
@@ -165,9 +227,15 @@ def main():
                                  sequence_length=512,
                                  attention_impl="flash")
     else:
+        # sequence length follows the requested trace: a 48-token prompt
+        # with a 32-token budget must not silently truncate to "length"
+        # finishes at the model's 64-row KV ceiling
+        seq = 64
+        while seq < prompt_len + max_new + 8:
+            seq *= 2
         lm = TransformerLMConfig(vocab_size=256, hidden_size=64,
                                  num_heads=4, num_layers=2,
-                                 sequence_length=64, attention_impl="xla")
+                                 sequence_length=seq, attention_impl="xla")
     config = FFConfig()
     config.batch_size = 8
     ff = FFModel(config)
@@ -187,14 +255,28 @@ def main():
         for i in range(n_requests)]
 
     serve_kw = {"kv_block_size": kv_block_size} if kv_block_size else {}
-    layouts = ("paged", "contiguous") if layout == "both" else (layout,)
+    if disaggregate:
+        # the acceptance comparison: unified paged vs disaggregated on
+        # the IDENTICAL trace at equal total chips — TTFT/TBT/queue-wait
+        # percentiles print side by side under the _paged/_disagg keys
+        layouts = ("paged", "disagg")
+    else:
+        layouts = (("paged", "contiguous") if layout == "both"
+                   else (layout,))
     results = {}
     completions = {}
     for lay in layouts:
+        extra = dict(serve_kw) if lay in ("paged", "disagg") else {}
+        if disaggregate and lay == "paged":
+            # the acceptance baseline is the unified r16 engine: prefix
+            # sharing spans LIVE residents only (no cross-time radix
+            # cache) — what the unified path was before ISSUE 19
+            extra["prefix_cache"] = False
         completions[lay], results[lay] = run_trace(
-            ff, lay, prompts, slots, max_new,
-            arrival_rate=arrival_rate, burst=burst,
-            **(serve_kw if lay == "paged" else {}))
+            ff, "paged" if lay == "disagg" else lay, prompts, slots,
+            max_new, arrival_rate=arrival_rate, burst=burst,
+            disaggregate=(lay == "disagg"),
+            warm="trace" if disaggregate else "slots", **extra)
         print(json.dumps({
             "metric": f"serving_requests_per_sec_per_chip_{lay}",
             "value": round(
@@ -212,10 +294,27 @@ def main():
                         "value": round(results[lay][key], 6),
                         "unit": "s",
                     }))
-    if layout == "both" and completions["paged"] != completions["contiguous"]:
+    if ("contiguous" in completions
+            and completions["paged"] != completions["contiguous"]):
         print("serve_bench: FAIL — paged completions diverge from "
               "contiguous", file=sys.stderr)
         sys.exit(1)
+    if "disagg" in completions:
+        if completions["disagg"] != completions["paged"]:
+            print("serve_bench: FAIL — disaggregated completions diverge "
+                  "from the unified paged engine", file=sys.stderr)
+            sys.exit(1)
+        print(json.dumps({
+            "metric": "serving_disagg_ttft_p95_s",
+            "value": results["disagg"].get("ttft_p95_s"),
+            "unified_ttft_p95_s": results["paged"].get("ttft_p95_s"),
+            "handoffs": results["disagg"].get("handoffs", 0),
+            "handoff_predicted_s": round(
+                results["disagg"].get("handoff_predicted_s", 0.0), 6),
+            "handoff_measured_s": round(
+                results["disagg"].get("handoff_measured_s", 0.0), 6),
+            "unit": "s",
+        }))
 
     payload = {"shared_prefix": shared_prefix, "requests": n_requests,
                "prompt_len": prompt_len, "max_new_tokens": max_new,
