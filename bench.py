@@ -1000,7 +1000,84 @@ def _serving_legs(cfg, on_tpu: bool) -> dict:
             out["disagg"] = {"skipped": f"{type(e).__name__}: {e}"}
     else:
         out["disagg"] = {"skipped": "single device — no chips to split"}
+
+    # speculative leg (`serving.spec` in the BENCH payload): the same
+    # shared-prefix trace through serve(speculate=True, draft_model=...)
+    # with a seed-clone drafter (the all-accept extreme — the verify-path
+    # ceiling on untrained weights), colocated so no extra chips are
+    # consumed: TBT p50/p95 + decode tokens/s/chip next to the unified
+    # paged engine, plus the acceptance rate and the payoff gate's
+    # decision tally. Bit-identity to the unified drain is asserted —
+    # speculation is a latency optimization, never a sampling change.
+    try:
+        out["spec"] = _spec_serving_leg(
+            ff, cfg, telemetry, sp, slots, max_new, block,
+            sorted(paged_eng.scheduler.completed,
+                   key=lambda r: r.request_id), pst)
+    except Exception as e:
+        out["spec"] = {"skipped": f"{type(e).__name__}: {e}"}
     return out
+
+
+def _spec_serving_leg(ff, lm_cfg, telemetry, prompts, slots, max_new,
+                      block, unified_done, unified_stats) -> dict:
+    """One `serving.spec` payload: the shared-prefix trace through the
+    speculative engine (seed-clone drafter, colocated), asserted
+    bit-identical to the unified paged drain (`unified_done`, sorted by
+    request id)."""
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import build_transformer_lm
+
+    dconfig = FFConfig()
+    dconfig.batch_size = slots
+    draft = FFModel(dconfig)
+    build_transformer_lm(draft, lm_cfg, batch_size=slots)
+    with telemetry.span("bench.serve.compile", leg="spec-drafter"):
+        draft.compile(
+            optimizer=SGDOptimizer(lr=0.01),
+            loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    eng = ff.serve(speculate=True, draft_model=draft, slots=slots,
+                   max_new_tokens=max_new, prefill_chunk=8,
+                   kv_block_size=block)
+    with telemetry.span("bench.serve.warmup", leg="spec"):
+        # full-trace warmup: compiles the decode buckets AND the
+        # drafter/verify executables, and warms the acceptance EMA so
+        # the measured wave runs on a calibrated payoff gate
+        eng.generate(prompts)
+    eng.reset_stats()
+    for p in prompts:
+        eng.submit(p)
+    with telemetry.span("bench.serve.measure", leg="spec",
+                        requests=len(prompts)):
+        eng.run_until_drained()
+    done = sorted(eng.scheduler.completed, key=lambda r: r.request_id)
+    if [r.generated for r in done] != [r.generated for r in unified_done]:
+        raise AssertionError(
+            "speculative completions diverge from the unified paged "
+            "engine on the shared-prefix trace")
+    st = eng.metrics_summary()
+    sp = eng.stats()["speculation"]
+    leg = {
+        "draft_chips": eng.draft_chips,
+        "k_max": eng.k_max,
+        "rounds": sp["rounds"],
+        "acceptance_rate": round(sp["acceptance_rate"], 4),
+        "acceptance_ema": round(sp["acceptance_ema"], 4),
+        "decision_counts": sp["decision_counts"],
+        "requests": len(prompts),
+        "decode_tokens_per_sec_per_chip": round(
+            st.get("decode_tokens_per_sec_per_chip", 0.0), 2),
+        "unified_decode_tokens_per_sec_per_chip": round(
+            unified_stats.get("decode_tokens_per_sec_per_chip", 0.0), 2),
+    }
+    for q in ("p50", "p95"):
+        key = f"tbt_{q}_s"
+        if key in st:
+            leg[key] = round(st[key], 6)
+        if key in unified_stats:
+            leg[f"unified_{key}"] = round(unified_stats[key], 6)
+    return leg
 
 
 def _disagg_serving_leg(ff, telemetry, prompts, slots, max_new, block,
@@ -1276,6 +1353,18 @@ def _bench_body(jax, TransformerLMConfig, telemetry, session):
                 "metric": "serving_disagg_prefix_hit_rate_cross_time",
                 "value": dg.get("prefix_hit_rate_cross_time"),
                 "no_cross_time": dg.get("prefix_hit_rate_no_cross_time"),
+            }))
+        sg = serving.get("spec") or {}
+        if "rounds" in sg:
+            # the speculation headline: TBT p95 vs plain decode at the
+            # same chips, with the acceptance rate that priced the gate
+            print(json.dumps({
+                "metric": "serving_spec_tbt_p95_s",
+                "value": sg.get("tbt_p95_s"),
+                "unified_tbt_p95_s": sg.get("unified_tbt_p95_s"),
+                "acceptance_rate": sg.get("acceptance_rate"),
+                "rounds": sg.get("rounds"),
+                "unit": "s",
             }))
     except Exception as e:  # pragma: no cover - defensive
         print(f"bench: serving leg failed: {e}", file=sys.stderr)

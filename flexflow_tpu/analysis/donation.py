@@ -41,6 +41,9 @@ BUILDER_CALLEES = {
     "build_chunked_train_step": ("chunk_fn",),
     "build_eval_step": ("eval_fn", "_eval_step"),
     "build_decode_step": ("_step_fn", "_decode_step"),
+    # speculative decoding's batched multi-token verification: the
+    # target's KV state is donated, so the engine rebinds it per call
+    "build_verify_step": ("_verify_fn", "_verify_step"),
     "build_block_copy": ("_copy_fn",),
     # disaggregated serving's KV handoff landing: the decode-side pools
     # are donated, so the coordinator rebinds the decode state

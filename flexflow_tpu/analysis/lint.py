@@ -137,6 +137,8 @@ DONATED_CALLEES = {
     "_eval_step": (2,),
     "_step_fn": (1,),                 # build_decode_step (KV-cache state)
     "_decode_step": (1,),
+    "_verify_fn": (1,),               # build_verify_step (speculative)
+    "_verify_step": (1,),
     "_copy_fn": (0,),                 # build_block_copy (paged KV pools)
     "_inject_fn": (0,),               # build_kv_inject (disagg handoff)
     "_gather_fn": (0,),               # build_param_gather (stage-3 tree)

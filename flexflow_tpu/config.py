@@ -223,7 +223,13 @@ class FFConfig:
     # plan fingerprint so the two plans cache independently.
     serve_disaggregate: bool = False
     serve_prefill_chips: int = 0
-    serve_role: str = ""  # "" | "prefill" | "decode"
+    serve_role: str = ""  # "" | "prefill" | "decode" | "draft"
+    # Speculative decoding (serving/speculative.py): serve_draft_chips
+    # places the drafter LM on its own trailing sub-mesh (0 → colocated
+    # with the target); serve_spec_k caps the per-round draft length the
+    # acceptance-calibrated payoff gate may choose.
+    serve_draft_chips: int = 0
+    serve_spec_k: int = 4
     # First device this mesh draws from jax.devices() — sub-meshes over
     # disjoint device subsets (disaggregated serving) set it per side.
     mesh_device_offset: int = 0
@@ -554,6 +560,10 @@ class FFConfig:
                 self.serve_disaggregate = True
             elif a == "--serve-prefill-chips":
                 self.serve_prefill_chips = int(val())
+            elif a == "--serve-draft-chips":
+                self.serve_draft_chips = int(val())
+            elif a == "--serve-spec-k":
+                self.serve_spec_k = int(val())
             elif a == "--synthetic-input":
                 self.synthetic_input = True
             elif a == "--allow-tensor-op-math-conversion":

@@ -187,6 +187,7 @@ def diagnose(directory: str) -> dict:
         "trace_dropped_events": dropped_events,
         "strategy_report": report,
         "serving_disagg": (report or {}).get("serving_disagg"),
+        "speculation": (report or {}).get("speculation"),
         "profile": profile,
         "flight": flight,
         "watchdog": watchdog,
@@ -339,6 +340,34 @@ def render(d: dict) -> str:
                 f"{last.get('new_prefill_chips')} prefill chips, "
                 f"lhs {last.get('lhs_s', 0.0) * 1e3:.3f} ms vs rhs "
                 f"{last.get('rhs_s', 0.0) * 1e3:.3f} ms)")
+
+    sp = d.get("speculation")
+    if sp:
+        drafted = sp.get("draft_tokens", 0)
+        counts = sp.get("decision_counts") or {}
+        place = ("colocated" if sp.get("colocated")
+                 else f"{sp.get('draft_chips')} dedicated chip(s)")
+        dplan = (sp.get("drafter") or {}).get("plan_source", "?")
+        lines += ["", "## Speculative decoding", "",
+                  f"- drafter: {place} (plan `{dplan}`)  ·  "
+                  f"K max {sp.get('k_max', '?')}  ·  pair "
+                  f"`{sp.get('pair_key', '?')}`",
+                  f"- acceptance EMA: {sp.get('acceptance_ema', 0.0):.3f} "
+                  f"({sp.get('acceptance_samples', 0)} samples)  ·  "
+                  f"accepted {sp.get('accepted_tokens', 0)}/{drafted} "
+                  f"drafted over {sp.get('rounds', 0)} round(s)",
+                  f"- payoff gate: {counts.get('speculate', 0)} "
+                  f"speculated / {counts.get('decode', 0)} plain-decode "
+                  f"decision(s)"]
+        last = next((x for x in reversed(sp.get("decisions") or [])
+                     if x.get("reason") == "payoff"), None)
+        if last:
+            lines.append(
+                f"- last payoff decision: {last.get('chosen')} at "
+                f"K={last.get('k')} (lhs "
+                f"{last.get('lhs_s', 0.0) * 1e3:.3f} ms vs rhs "
+                f"{last.get('rhs_s', 0.0) * 1e3:.3f} ms, verify cost "
+                f"{last.get('verify_cost_source', '?')})")
 
     prof = d.get("profile")
     if prof:

@@ -828,6 +828,37 @@ class Executor:
             decode_step, donate_argnums=_donate_argnums((1,)))
         return self._decode_step
 
+    def build_verify_step(self):
+        """Speculative-decoding verification as a donated executable:
+        forward q = K+1 tokens per slot through the decode graph (the
+        incremental-attention ops already take (slots, q) positions —
+        the chunked-prefill multi-token path) and return EVERY row's
+        greedy argmax, (slots, q) int32 — row j is the target's token
+        for position `positions[s, j] + 1`. The host compares the
+        drafter's proposals against this vector to accept the longest
+        matching prefix + one correction token (serving/speculative.py);
+        greedy-only by construction, which is what keeps speculative
+        streams bit-identical to plain decode. Distinct draft lengths
+        retrace into their own cached executables — the draft-length
+        bucket set falls out of jit's shape specialization, like the
+        prefill buckets. Donating `state` updates the KV cache in place;
+        rejected rows need no device-side rollback — the host rewinds
+        its position cursor and the next call's writes land over them
+        before any masked read can see them."""
+
+        def verify_step(params, state, x_inputs):
+            logits, new_state, _ = self._apply(
+                params, state,
+                self._cast_compute(x_inputs), training=False, rng=None,
+            )
+            toks = jnp.argmax(logits.astype(jnp.float32),
+                              axis=-1).astype(jnp.int32)  # (slots, q)
+            return self._restore_state_dtypes(new_state), toks
+
+        self._verify_step = jax.jit(
+            verify_step, donate_argnums=_donate_argnums((1,)))
+        return self._verify_step
+
     def build_block_copy(self):
         """Copy-on-write support for the paged KV layout: duplicate pool
         blocks src[i] → dst[i] across EVERY layer's pool_k/pool_v in one

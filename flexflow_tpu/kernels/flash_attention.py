@@ -1338,7 +1338,14 @@ def decode_attention_reference(q, k, v, positions, *, num_heads: int,
     Query row i attends cache rows [0, positions[s, i]] — intra-chunk
     causality during prefill falls out of the per-row positions. Same
     where(-1e30)/softmax convention as sdpa_xla, so greedy decode is
-    token-identical to the teacher-forced training forward."""
+    token-identical to the teacher-forced training forward. The
+    speculative verify call (serving/speculative.py) rides the SAME
+    multi-query path at q_len=K+1 — each proposal row's logits equal
+    what plain decode would compute after the rows before it, which is
+    the whole bit-identity argument; the Pallas kernels below stay
+    q_len=1, so multi-query calls (prefill chunks and verify alike)
+    take this einsum on every backend — a multi-query Pallas decode
+    kernel is the ROADMAP item that would close the gap."""
     slots, q_len, e = q.shape
     s_k = k.shape[1]
     h = num_heads

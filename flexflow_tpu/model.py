@@ -2283,15 +2283,47 @@ class FFModel:
         independent Unity plans on disjoint sub-meshes (serve_prefill_chips
         sizes the prefill side), with each request's KV handed off
         through a verified, priced fftrans transfer program
-        (docs/serving.md "Disaggregated serving")."""
+        (docs/serving.md "Disaggregated serving").
+
+        `speculate=True, draft_model=<small compiled LM>` builds a
+        SpeculativeServingEngine: the drafter proposes K tokens per
+        round and the target verifies them in one batched call, gated
+        by an acceptance-calibrated payoff inequality — token streams
+        stay bit-identical to plain decode (serve_draft_chips places
+        the drafter on a disjoint sub-mesh; docs/serving.md
+        "Speculative decoding")."""
         assert self._compiled, "call compile() before serve()"
+        # fail fast on chip-budget flags that exceed THIS process's
+        # visible devices, naming the flag — a bad sub-mesh carve
+        # otherwise surfaces as an opaque mesh-factorization error
+        n_dev = len(jax.devices())
+        for flag, field in (("--serve-prefill-chips", "serve_prefill_chips"),
+                            ("--serve-draft-chips", "serve_draft_chips")):
+            chips = int(getattr(self.config, field, 0) or 0)
+            if chips >= n_dev:
+                raise ValueError(
+                    f"{flag}={chips} but only {n_dev} device(s) are "
+                    f"visible; both sides of the split need at least "
+                    f"one chip")
         disaggregate = kwargs.pop(
             "disaggregate",
             bool(getattr(self.config, "serve_disaggregate", False)))
+        speculate = kwargs.pop("speculate", False)
+        if disaggregate and speculate:
+            raise ValueError(
+                "serve(): disaggregate=True and speculate=True are "
+                "mutually exclusive for now (speculative decoding of "
+                "the disaggregated decode pool is a ROADMAP item)")
         if disaggregate:
+            kwargs.pop("draft_model", None)
             from .serving import DisaggregatedServingEngine
 
             return DisaggregatedServingEngine(self, **kwargs)
+        if speculate:
+            from .serving import SpeculativeServingEngine
+
+            return SpeculativeServingEngine(self, **kwargs)
+        kwargs.pop("draft_model", None)
         from .serving import ServingEngine
 
         return ServingEngine(self, **kwargs)

@@ -246,9 +246,21 @@ TRANSFORMER_LM_ZOO: dict = {
     "lm-smoke": TransformerLMConfig(
         vocab_size=512, hidden_size=128, num_heads=4, num_layers=2,
         sequence_length=128, attention_impl="xla"),
+    # speculative-decoding drafter for lm-smoke: same vocab + positional
+    # extent (a drafter must share the target's tokenizer and reach
+    # every position it decodes at — serving/speculative.py), a quarter
+    # the width and half the depth
+    "lm-smoke-draft": TransformerLMConfig(
+        vocab_size=512, hidden_size=32, num_heads=2, num_layers=1,
+        sequence_length=128, attention_impl="xla"),
     # the reference benchmark scale (transformer.cc:79-85)
     "lm-base": TransformerLMConfig(
         vocab_size=32000, hidden_size=1024, num_heads=16, num_layers=12,
+        sequence_length=512),
+    # drafter tier for lm-base: SpecInfer-style ~20x-smaller LM sharing
+    # the 32k vocab and 512-token extent
+    "lm-base-draft": TransformerLMConfig(
+        vocab_size=32000, hidden_size=256, num_heads=4, num_layers=4,
         sequence_length=512),
     # ~1.3B params: replicated Adam state ≈ 21 GB — over one 16 GB chip,
     # under it at 1/4 stage-3 shards

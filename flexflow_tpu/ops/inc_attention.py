@@ -24,6 +24,15 @@ per-element positions:
     [0, positions[s, i]] — intra-chunk causality during prefill falls out
     of the per-row positions; q_len=1 is the decode iteration.
 
+The same two properties make q_len=K+1 the speculative VERIFY call
+(serving/speculative.py): the drafter's K proposals plus the slot's last
+token feed at positions [L..L+K], every row's write lands BEFORE the
+masked read, and rows beyond a row's own position are invisible to it —
+so rejected proposals need no device-side erase. The engine just rewinds
+its host cursor: any stale row at or below a later call's query frontier
+is overwritten by that call's own scatter before it becomes readable,
+and rows beyond the frontier stay masked forever.
+
 Weight names match OP_MULTIHEAD_ATTENTION's (wq/wk/wv/wo + biases), so a
 trained model's parameters transfer to its decode graph by name. On TPU
 the q_len=1 path routes through the Pallas decode kernel

@@ -159,6 +159,18 @@ def price_transfer_collective(kind: str, wire_bytes: float,
     return wire_bytes / machine.chip.dcn_bandwidth
 
 
+def price_verify_scale(q: int) -> float:
+    """Relative cost of a q-token speculative VERIFY call vs the q=1
+    decode step (serving/speculative.py) — the assumed prior the payoff
+    gate uses for a verify bucket it has never run. Decode-grain calls
+    are launch/weight-read dominated, not FLOP dominated, so widening
+    the query dim from 1 to q costs far less than qx: a conservative
+    linear tail (quarter-slope) over the fixed launch cost. The first
+    real call replaces this with the measured per-bucket EMA; decisions
+    record which source priced them (`verify_cost_source`)."""
+    return 1.0 + 0.25 * (max(1, int(q)) - 1)
+
+
 def _shard_elems(shape: tuple[int, ...], assignment, axis_sizes) -> float:
     """Per-chip element count of a tensor under an axis assignment."""
     n = 1.0

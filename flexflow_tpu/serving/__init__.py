@@ -23,9 +23,11 @@ from .engine import ServingEngine
 from .paged import BlockManager, CopyPlan, PagedStats
 from .radix import RadixPrefixCache
 from .scheduler import ContinuousBatchingScheduler, Request, Slot
+from .speculative import DrafterPlane, SpeculativeServingEngine
 
 __all__ = [
-    "ServingEngine", "DisaggregatedServingEngine", "ServingSpec",
+    "ServingEngine", "DisaggregatedServingEngine",
+    "SpeculativeServingEngine", "DrafterPlane", "ServingSpec",
     "Request", "Slot",
     "ContinuousBatchingScheduler", "build_decode_model", "adopt_params",
     "BlockManager", "CopyPlan", "PagedStats", "RadixPrefixCache",

@@ -44,6 +44,35 @@ from .engine import ServingEngine
 from .scheduler import Request
 
 
+def sub_mesh_axes(model, n: int) -> tuple:
+    """The n-chip sub-mesh factorization of `model`'s configured mesh:
+    rescale the data axis, every other axis kept — the same shape
+    discipline the elastic capacity trigger uses, so a sub-mesh plan is
+    always a shape the search already prices. Shared by the
+    disaggregated (prefill/decode) and speculative (drafter/target)
+    engines — both carve disjoint device windows with it."""
+    from ..machine import AXIS_DATA, DEFAULT_AXES
+
+    ms = model.config.mesh_shape()
+    sizes = list(int(s) for s in ms.axis_sizes)
+    names = list(ms.axis_names)
+    if len(names) != len(DEFAULT_AXES):
+        raise ValueError(
+            "sub-mesh serving runs single-host for now "
+            "(multi-host meshes carry a dcn axis)")
+    di = names.index(AXIS_DATA)
+    fixed = 1
+    for i, s in enumerate(sizes):
+        if i != di:
+            fixed *= s
+    if n % fixed:
+        raise ValueError(
+            f"{n} chips cannot keep the non-data axes "
+            f"(product {fixed}) of mesh {tuple(sizes)}")
+    sizes[di] = n // fixed
+    return tuple(sizes)
+
+
 class DisaggregatedServingEngine:
     """Two ServingEngines on disjoint device windows + the KV handoff
     plane between them. Mirrors the ServingEngine surface (submit /
@@ -125,30 +154,7 @@ class DisaggregatedServingEngine:
         return self._total_chips - self.prefill_chips
 
     def _sub_axes(self, n: int) -> tuple:
-        """The n-chip sub-mesh factorization: rescale the trainer
-        mesh's data axis, every other axis kept — the same shape
-        discipline the elastic capacity trigger uses, so a sub-mesh
-        plan is always a shape the search already prices."""
-        from ..machine import AXIS_DATA, DEFAULT_AXES
-
-        ms = self.model.config.mesh_shape()
-        sizes = list(int(s) for s in ms.axis_sizes)
-        names = list(ms.axis_names)
-        if len(names) != len(DEFAULT_AXES):
-            raise ValueError(
-                "disaggregated serving runs single-host for now "
-                "(multi-host meshes carry a dcn axis)")
-        di = names.index(AXIS_DATA)
-        fixed = 1
-        for i, s in enumerate(sizes):
-            if i != di:
-                fixed *= s
-        if n % fixed:
-            raise ValueError(
-                f"{n} chips cannot keep the non-data axes "
-                f"(product {fixed}) of mesh {tuple(sizes)}")
-        sizes[di] = n // fixed
-        return tuple(sizes)
+        return sub_mesh_axes(self.model, n)
 
     # ------------------------------------------------------------ intake
 

@@ -173,6 +173,7 @@ class ServingEngine:
         self._prefill_tokens = 0
         self._prefill_calls = 0
         self._device_s = 0.0
+        self._last_step_device_s = 0.0  # most recent device call's wall
         # ffpulse metrics plane: engine-owned registry so serving metrics
         # exist (and metrics_summary works) without a telemetry dir, and
         # reset_stats can zero the serving series alone. Every series the
@@ -384,15 +385,16 @@ class ServingEngine:
             b *= 2
         return min(b, self.spec.prefill_chunk)
 
-    def _run_step(self, tokens: np.ndarray, positions: np.ndarray,
-                  read_idx: np.ndarray) -> np.ndarray:
-        """One decode-graph call: stage inputs with their searched
-        shardings, run the donated step, return the sampled tokens."""
-        import jax
-        import jax.numpy as jnp
-
-        dec = self.decode_model
+    def _stage_inputs(self, tokens: np.ndarray,
+                      positions: np.ndarray) -> dict:
+        """Stage one decode-graph call's input dict under the searched
+        shardings: the token stream, positions, the page tables (paged
+        layout), and the graph's constant feeds broadcast to the call's
+        q width. Shared between the decode step and the speculative
+        verify step (serving/speculative.py) so the two calls stage
+        byte-identical feeds."""
         q = tokens.shape[1]
+        dec = self.decode_model
         xs = {self._token_input: tokens, "positions": positions}
         if self.block_manager is not None:
             mgr = self.block_manager
@@ -408,7 +410,17 @@ class ServingEngine:
             spec = dec._input_partition_spec(name)
             if spec is not None:
                 specs[name] = spec
-        xs = dec.executor.shard_batch(xs, specs)
+        return dec.executor.shard_batch(xs, specs)
+
+    def _run_step(self, tokens: np.ndarray, positions: np.ndarray,
+                  read_idx: np.ndarray) -> np.ndarray:
+        """One decode-graph call: stage inputs with their searched
+        shardings, run the donated step, return the sampled tokens."""
+        import jax
+        import jax.numpy as jnp
+
+        dec = self.decode_model
+        xs = self._stage_inputs(tokens, positions)
         if self._rng is None:
             self._rng = jax.random.key(dec.config.seed)
         self._rng, sub = jax.random.split(self._rng)
@@ -425,6 +437,7 @@ class ServingEngine:
         # below) — a span here would double-record every decode step
         dt = time.perf_counter() - t0  # fflint: ok raw_timer_in_hot_path
         self._device_s += dt
+        self._last_step_device_s = dt  # speculative decode-cost EMA feed
         self._h_step_device.observe(dt)
         if dec.config.sanitize_numerics:
             self._check_numerics()
@@ -646,6 +659,31 @@ class ServingEngine:
 
     # ------------------------------------------------------------ iterate
 
+    def _publish_slot_gauges(self, prefilling, decoding):
+        """Per-iteration occupancy/pool gauges — shared between the
+        plain step and the speculative verify round (speculative.py), so
+        both iteration shapes feed the same metrics plane."""
+        sched = self.scheduler
+        self._g_slots_active.set(len(prefilling) + len(decoding))
+        self._g_queue_depth.set(sched.queue_depth)
+        if self.block_manager is not None:
+            mgr = self.block_manager
+            self._g_blocks_free.set(mgr.free_blocks)
+            self._g_blocks_used.set(mgr.blocks_in_use)
+            self._g_blocks_reserved.set(mgr.reserved_total)
+            cached_only = mgr.cached_only_blocks
+            self._g_prefix_cached.set(cached_only)
+            self._g_prefix_pinned.set(mgr.cached_blocks - cached_only)
+            ev = mgr.stats.radix_evictions
+            if ev > self._evictions_seen:
+                self._c_prefix_evictions.inc(ev - self._evictions_seen)
+                self._evictions_seen = ev
+        telemetry.counter("serve.slots", {
+            "active": len(prefilling) + len(decoding),
+            "queue": sched.queue_depth,
+            "occupancy": (len(prefilling) + len(decoding))
+            / max(1, len(sched.slots))})
+
     def step(self) -> list[Request]:
         """ONE scheduler iteration (the Orca unit), ONE device call: admit
         pending requests into free slots, pick AT MOST ONE prefill chunk
@@ -670,25 +708,7 @@ class ServingEngine:
                                   queue_wait_s=req.queue_wait_s)
             prefilling = [s for s in sched.slots if s.prefilling]
             decoding = [s for s in sched.slots if s.decoding]
-            self._g_slots_active.set(len(prefilling) + len(decoding))
-            self._g_queue_depth.set(sched.queue_depth)
-            if self.block_manager is not None:
-                mgr = self.block_manager
-                self._g_blocks_free.set(mgr.free_blocks)
-                self._g_blocks_used.set(mgr.blocks_in_use)
-                self._g_blocks_reserved.set(mgr.reserved_total)
-                cached_only = mgr.cached_only_blocks
-                self._g_prefix_cached.set(cached_only)
-                self._g_prefix_pinned.set(mgr.cached_blocks - cached_only)
-                ev = mgr.stats.radix_evictions
-                if ev > self._evictions_seen:
-                    self._c_prefix_evictions.inc(ev - self._evictions_seen)
-                    self._evictions_seen = ev
-            telemetry.counter("serve.slots", {
-                "active": len(prefilling) + len(decoding),
-                "queue": sched.queue_depth,
-                "occupancy": (len(prefilling) + len(decoding))
-                / max(1, len(sched.slots))})
+            self._publish_slot_gauges(prefilling, decoding)
             if not prefilling and not decoding:
                 return sched.completed[done_before:]
 

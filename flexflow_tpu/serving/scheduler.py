@@ -235,3 +235,21 @@ class ContinuousBatchingScheduler:
             return True
         slot.last_token = int(token)
         return False
+
+    def note_tokens(self, slot: Slot, tokens: list[int]) -> tuple[int, bool]:
+        """Record a RUN of sampled tokens for `slot`'s request — the
+        speculative-decoding acceptance path, where one verify call
+        emits up to K+1 tokens at once. Applies the same per-token
+        completion rules as `note_token`, in the same order, stopping at
+        the first one that fires: plain decode would never have sampled
+        past it, so dropping the tail is exactly what keeps speculative
+        streams bit-identical. The engine advances `slot.length` before
+        each token lands, mirroring its one-token loop. Returns
+        (tokens_applied, finished)."""
+        applied = 0
+        for tok in tokens:
+            slot.length += 1
+            applied += 1
+            if self.note_token(slot, int(tok)):
+                return applied, True
+        return applied, False

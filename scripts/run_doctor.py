@@ -180,6 +180,58 @@ def main():
                     problems.append(
                         f"elastic decision {i}: declined but the "
                         f"pay-off inequality holds ({lhs} < {rhs})")
+            # speculative-decoding gate: every payoff decision in the
+            # speculation section must be reproducible from its own
+            # recorded factors — lhs = K·draft + verify, rhs =
+            # (Σ_{i=1..K} a^i)·decode (the SAME accumulation order as
+            # the engine, so the floats match) — and the chosen call
+            # must agree with the inequality. Calibration rounds
+            # (calibrate_decode / bootstrap / no_headroom) carry no
+            # priced inequality and are exempt from the call check.
+            spec = rep.get("speculation")
+            if spec is not None:
+                for i, dec in enumerate(spec.get("decisions", [])):
+                    if dec.get("reason") != "payoff":
+                        continue
+                    k = int(dec.get("k", 0))
+                    lhs = (k * dec.get("draft_cost_s", 0.0)
+                           + dec.get("verify_cost_s", 0.0))
+                    a = dec.get("acceptance_ema", 0.0)
+                    exp = 0.0
+                    x = 1.0
+                    for _ in range(k):
+                        x *= a
+                        exp += x
+                    rhs = exp * dec.get("decode_cost_s", 0.0)
+                    for name, got, want in (
+                            ("lhs_s", dec.get("lhs_s"), lhs),
+                            ("expected_accepted",
+                             dec.get("expected_accepted"), exp),
+                            ("rhs_s", dec.get("rhs_s"), rhs)):
+                        if got is None or abs(got - want) > (
+                                1e-9 + 1e-6 * abs(want)):
+                            problems.append(
+                                f"speculation decision {i}: recorded "
+                                f"{name} ({got}) does not reproduce "
+                                f"from its factors ({want})")
+                    chosen = dec.get("chosen")
+                    if chosen == "speculate" and not lhs < rhs:
+                        problems.append(
+                            f"speculation decision {i}: speculated but "
+                            f"the payoff inequality does not hold "
+                            f"({lhs} >= {rhs})")
+                    if chosen == "decode" and lhs < rhs:
+                        problems.append(
+                            f"speculation decision {i}: fell back to "
+                            f"plain decode but the payoff inequality "
+                            f"holds ({lhs} < {rhs})")
+                drafted = spec.get("draft_tokens", 0)
+                accepted = spec.get("accepted_tokens", 0)
+                if accepted > drafted:
+                    problems.append(
+                        f"speculation section accepted {accepted} of "
+                        f"{drafted} drafted tokens — acceptance cannot "
+                        f"exceed the drafted count")
             # ffpulse gate: every metrics_snapshot must be self-
             # consistent from the artifact alone — for each histogram
             # the bucket counts must sum to the recorded total, and on a

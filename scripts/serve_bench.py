@@ -8,7 +8,8 @@ with tunable load, for serving-focused profiling:
   python scripts/serve_bench.py [--requests N] [--slots S]
       [--prompt-len P] [--max-new-tokens T] [--shared-prefix K]
       [--arrival-rate R] [--burst B] [--layout paged|contiguous|both]
-      [--disaggregate] [--telemetry-dir DIR] [flexflow flags]
+      [--disaggregate] [--speculate [--draft-chips D]]
+      [--telemetry-dir DIR] [flexflow flags]
 
 --shared-prefix K (default: prompt-len // 2) prepends one K-token system
 prompt to every request — the N-users-one-system-prompt trace the paged
@@ -32,6 +33,15 @@ completions asserted bit-identical, and the payload carries both sides'
 TTFT/TBT/queue-wait percentiles plus the handoff measured-vs-predicted
 seconds — the ISSUE 19 acceptance harness for "disagg + radix cache
 improves TTFT p95 at equal chips on the bursty shared-prefix trace".
+
+--speculate replaces the layout ablation with the SPECULATION ablation
+(docs/serving.md "Speculative decoding"): the identical trace runs
+through the plain paged engine and through serve(speculate=True,
+draft_model=...) with a seed-clone drafter (--draft-chips D > 0 places
+it on a disjoint sub-mesh), completions asserted bit-identical, and the
+payload carries both sides' TBT percentiles plus the acceptance rate,
+round count, and payoff-gate decision tally — the ISSUE 20 ablation leg
+for "speculation reduces TBT when the payoff inequality holds".
 
 With --layout both (default) the same trace runs through both KV layouts
 and the report carries, next to each layout's req/s/chip:
@@ -116,7 +126,8 @@ def open_loop_offsets(n, rate, burst, rs):
 
 
 def run_trace(ff, layout, prompts, slots, max_new, arrival_rate=0.0,
-              burst=1.0, disaggregate=False, warm="slots", **serve_kw):
+              burst=1.0, disaggregate=False, speculate=False,
+              draft_model=None, draft_chips=0, warm="slots", **serve_kw):
     """Run `prompts` through a fresh engine of `layout`; returns
     (completions, metrics_summary) with the measured window warmed +
     reset. arrival_rate > 0 drives the trace open-loop (submission by
@@ -133,6 +144,11 @@ def run_trace(ff, layout, prompts, slots, max_new, arrival_rate=0.0,
         kw["slots"] = slots
     if disaggregate:
         kw["disaggregate"] = True
+    if speculate:
+        kw["speculate"] = True
+        kw["draft_model"] = draft_model
+        if draft_chips:
+            kw["draft_chips"] = draft_chips
     engine = ff.serve(**kw)
     # warm the bucket/decode/copy executables so the measured drain is
     # steady state: a full slot-width batch compiles every decode batch
@@ -173,6 +189,10 @@ def run_trace(ff, layout, prompts, slots, max_new, arrival_rate=0.0,
                   key=lambda r: r.request_id)  # submission order: the
     # cross-layout parity check must not depend on completion timing
     stats = engine.metrics_summary()
+    if speculate:
+        # the speculation accounting for the measured window: acceptance
+        # rate, rounds, and how the payoff gate actually decided
+        stats["speculation"] = engine.stats()["speculation"]
     if disaggregate:
         # lift the per-side request-grain percentiles to the flat keys
         # the payload loop below reads: TTFT + queue wait observe on the
@@ -199,6 +219,12 @@ def main():
     burst = _pop_float(argv, "--burst", 1.0)
     layout = _pop_str(argv, "--layout", "both")
     disaggregate = _pop_flag(argv, "--disaggregate")
+    speculate = _pop_flag(argv, "--speculate")
+    draft_chips = _pop_int(argv, "--draft-chips", 0)
+    if disaggregate and speculate:
+        print("serve_bench: --disaggregate and --speculate are mutually "
+              "exclusive", file=sys.stderr)
+        sys.exit(2)
     sys.argv = [sys.argv[0]] + argv
     if not kv_block_size:
         # block granularity must divide INTO the shared prefix for the
@@ -243,6 +269,21 @@ def main():
     ff.compile(optimizer=SGDOptimizer(lr=0.01),
                loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
 
+    draft = None
+    if speculate:
+        # seed-clone drafter: identical weights put acceptance at its
+        # upper extreme, so the leg measures the verify-path ceiling
+        # (the TRANSFORMER_LM_ZOO *-draft tiers are the realistic
+        # trained drafters; untrained random weights would reject ~all
+        # proposals and measure nothing)
+        dconfig = FFConfig()
+        dconfig.batch_size = 8
+        draft = FFModel(dconfig)
+        build_transformer_lm(draft, lm, batch_size=8)
+        draft.compile(
+            optimizer=SGDOptimizer(lr=0.01),
+            loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
     # the shared-prefix trace: one system prompt opens every request
     # (served alone first so the partial tail block registers and later
     # extensions exercise COW), distinct suffixes after it
@@ -260,23 +301,29 @@ def main():
         # the IDENTICAL trace at equal total chips — TTFT/TBT/queue-wait
         # percentiles print side by side under the _paged/_disagg keys
         layouts = ("paged", "disagg")
+    elif speculate:
+        # plain decode vs drafter+verify on the IDENTICAL trace — TBT
+        # percentiles print side by side under the _paged/_spec keys
+        layouts = ("paged", "spec")
     else:
         layouts = (("paged", "contiguous") if layout == "both"
                    else (layout,))
     results = {}
     completions = {}
     for lay in layouts:
-        extra = dict(serve_kw) if lay in ("paged", "disagg") else {}
+        extra = dict(serve_kw) if lay in ("paged", "disagg", "spec") else {}
         if disaggregate and lay == "paged":
             # the acceptance baseline is the unified r16 engine: prefix
             # sharing spans LIVE residents only (no cross-time radix
             # cache) — what the unified path was before ISSUE 19
             extra["prefix_cache"] = False
         completions[lay], results[lay] = run_trace(
-            ff, "paged" if lay == "disagg" else lay, prompts, slots,
-            max_new, arrival_rate=arrival_rate, burst=burst,
-            disaggregate=(lay == "disagg"),
-            warm="trace" if disaggregate else "slots", **extra)
+            ff, "paged" if lay in ("disagg", "spec") else lay, prompts,
+            slots, max_new, arrival_rate=arrival_rate, burst=burst,
+            disaggregate=(lay == "disagg"), speculate=(lay == "spec"),
+            draft_model=draft, draft_chips=draft_chips,
+            warm="trace" if (disaggregate or speculate) else "slots",
+            **extra)
         print(json.dumps({
             "metric": f"serving_requests_per_sec_per_chip_{lay}",
             "value": round(
@@ -313,6 +360,22 @@ def main():
                 results["disagg"].get("handoff_predicted_s", 0.0), 6),
             "handoff_measured_s": round(
                 results["disagg"].get("handoff_measured_s", 0.0), 6),
+            "unit": "s",
+        }))
+    if "spec" in completions:
+        if completions["spec"] != completions["paged"]:
+            print("serve_bench: FAIL — speculative completions diverge "
+                  "from plain decode", file=sys.stderr)
+            sys.exit(1)
+        sp = results["spec"].get("speculation", {})
+        print(json.dumps({
+            "metric": "serving_spec_tbt_p95_s",
+            "value": results["spec"].get("tbt_p95_s"),
+            "plain_tbt_p95_s": results["paged"].get("tbt_p95_s"),
+            "acceptance_rate": round(sp.get("acceptance_rate", 0.0), 4),
+            "rounds": sp.get("rounds", 0),
+            "decision_counts": sp.get("decision_counts", {}),
+            "draft_chips": draft_chips,
             "unit": "s",
         }))
 

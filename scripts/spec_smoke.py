@@ -1,0 +1,197 @@
+"""Speculative-decoding smoke: drafter/verify vs plain decode on the CPU
+mesh — the CI gate for serving/speculative.py (docs/serving.md,
+"Speculative decoding").
+
+Runs a small Transformer LM on the virtual 8-device mesh and asserts
+
+  - `serve(speculate=True, draft_model=...)` with a high-acceptance
+    drafter (a seed-clone of the target) completes a trace with token
+    streams BIT-IDENTICAL to the unified engine — both colocated and
+    with `--serve-draft-chips` carving a disjoint drafter sub-mesh;
+  - the engine actually speculated (rounds >= 1) with acceptance rate
+    > 0, and the acceptance EMA persisted to the warm-start calibration
+    DB under the (target, drafter) pair key;
+  - the strategy report carries the `speculation` section whose payoff
+    decisions reproduce arithmetically, and `run_doctor --check`
+    re-verifies the inequality from the artifacts alone;
+  - the merged telemetry carries serve.speculate events and the spec
+    metric series in a drained snapshot.
+
+Usage:
+  python scripts/spec_smoke.py --telemetry-dir OUT [flexflow flags]
+Exits nonzero with a diagnostic on the first broken invariant.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+NUM_REQUESTS = 6
+
+
+def fail(msg: str):
+    print(f"spec_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def build(config_ctor, with_diag):
+    from flexflow_tpu import FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models import (TransformerLMConfig,
+                                     build_transformer_lm)
+
+    lm = TransformerLMConfig(vocab_size=128, hidden_size=32, num_heads=4,
+                             num_layers=2, sequence_length=32,
+                             attention_impl="xla")
+    config = config_ctor()
+    config.only_data_parallel = True
+    config.batch_size = 8
+    if with_diag:
+        config.diagnostics = True
+    else:
+        # one telemetry session per smoke: the drafter and the plain
+        # baseline compile silently
+        config.telemetry_dir = ""
+        config.diagnostics = False
+    ff = FFModel(config)
+    build_transformer_lm(ff, lm, batch_size=8)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff, lm
+
+
+def main():
+    from flexflow_tpu import FFConfig
+    from flexflow_tpu.serving.speculative import load_acceptance
+    from flexflow_tpu.telemetry import read_jsonl
+    from flexflow_tpu.warmstart.calibration_db import device_key
+
+    probe = FFConfig()
+    if not probe.telemetry_dir:
+        fail("pass --telemetry-dir")
+    tdir = probe.telemetry_dir
+    ws = os.path.join(tdir, "warmstart")
+
+    def ctor():
+        cfg = FFConfig()
+        cfg.warmstart_dir = ws
+        return cfg
+
+    ff, lm = build(ctor, with_diag=True)
+    # the drafter: a seed-clone of the target — identical weights give
+    # the all-accept extreme, the honest way to exercise acceptance on
+    # random (untrained) models
+    dff, _ = build(ctor, with_diag=False)
+
+    rs = np.random.RandomState(7)
+    prompts = [rs.randint(1, lm.vocab_size, rs.randint(2, 9)).tolist()
+               for _ in range(NUM_REQUESTS)]
+    serve_kw = dict(slots=4, max_new_tokens=8, prefill_chunk=4,
+                    kv_block_size=4)
+
+    unified = ff.serve(**serve_kw)
+    want = unified.generate(prompts)
+
+    # ---- colocated speculation: bit-identity + acceptance accounting
+    eng = ff.serve(speculate=True, draft_model=dff, **serve_kw)
+    got = eng.generate(prompts)
+    if got != want:
+        fail(f"speculative token streams diverge from plain decode:\n"
+             f"  plain {want}\n  spec  {got}")
+    sp = eng.stats()["speculation"]
+    if sp["rounds"] < 1:
+        fail("the engine never ran a speculative round")
+    if sp["draft_tokens"] < 1 or sp["accepted_tokens"] < 1:
+        fail(f"no acceptance recorded: {sp}")
+    if not sp["acceptance_rate"] > 0:
+        fail(f"acceptance rate must be > 0, got {sp['acceptance_rate']}")
+    print(f"spec_smoke: {NUM_REQUESTS} requests bit-identical, "
+          f"{sp['rounds']} speculative round(s), acceptance "
+          f"{sp['acceptance_rate']:.2f} "
+          f"({sp['accepted_tokens']}/{sp['draft_tokens']} drafted)")
+
+    # ---- the acceptance EMA persisted under the pair key
+    rate, samples = load_acceptance(ff, eng.pair_key)
+    if samples < 1:
+        fail("acceptance EMA did not persist at drain")
+    db_path = os.path.join(ws, "calibration.json")
+    if not os.path.exists(db_path):
+        fail(f"no calibration DB at {db_path}")
+    db = json.load(open(db_path))
+    keys = list((db.get("devices", {}).get(device_key()) or {}).keys())
+    if not any("__spec_acceptance__" in k for k in keys):
+        fail(f"calibration DB holds no __spec_acceptance__ entry: {keys}")
+    print(f"spec_smoke: acceptance EMA {rate:.3f} ({samples:.0f} samples) "
+          f"round-tripped through the warm-start calibration DB")
+
+    # ---- disjoint drafter sub-mesh: same streams at 4+4 chips
+    eng2 = ff.serve(speculate=True, draft_model=dff, draft_chips=4,
+                    **serve_kw)
+    tdev = {d.id for d in eng2.decode_model.mesh.devices.flat}
+    ddev = {d.id for d in eng2.drafter.engine.decode_model.mesh.devices.flat}
+    if tdev & ddev:
+        fail(f"drafter/target device windows overlap: {tdev & ddev}")
+    if len(tdev) != 4 or len(ddev) != 4:
+        fail(f"--serve-draft-chips carved {len(tdev)}t+{len(ddev)}d of 8")
+    if eng2.generate(prompts) != want:
+        fail("sub-mesh speculative streams diverge from plain decode")
+    print(f"spec_smoke: bit-identical again on disjoint sub-meshes "
+          f"({len(tdev)}t+{len(ddev)}d chips)")
+
+    # ---- report + telemetry surface
+    ff._telemetry.close()
+    rep = json.load(open(os.path.join(tdir, "strategy_report.json")))
+    sec = rep.get("speculation")
+    if sec is None:
+        fail("strategy_report.json has no speculation section")
+    if not sec.get("decisions"):
+        fail("speculation section carries no payoff decisions")
+    if sec.get("rounds", 0) < 1 or sec.get("accepted_tokens", 0) < 1:
+        fail(f"report speculation accounting empty: {sec}")
+    records = read_jsonl(os.path.join(tdir, "metrics.jsonl"))
+    kinds = {}
+    for r in records:
+        kinds[r.get("kind")] = kinds.get(r.get("kind"), 0) + 1
+    if kinds.get("serve.speculate", 0) < 1:
+        fail("no serve.speculate events in the telemetry stream")
+    snaps = [r for r in records if r.get("kind") == "metrics_snapshot"
+             and r.get("drained")]
+    if not snaps:
+        fail("no drained metrics snapshot")
+    counters = snaps[-1].get("metrics", {}).get("counters") or {}
+    if not any(k.startswith("serve_spec_rounds_total") for k in counters):
+        fail("drained snapshot missing serve_spec_rounds_total")
+
+    # ---- the doctor re-verifies the payoff inequality from the
+    # artifacts alone
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "run_doctor.py"),
+         tdir, "--check", "--out", os.path.join(tdir, "doctor.md")],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        fail(f"run_doctor --check failed:\n{r.stderr}")
+    doc = open(os.path.join(tdir, "doctor.md")).read()
+    if "Speculative decoding" not in doc:
+        fail("doctor report missing the speculative-decoding section")
+    print("spec_smoke: run_doctor --check re-verified every payoff "
+          "decision from the report alone")
+    print("spec_smoke: OK")
+
+
+if __name__ == "__main__":
+    main()
